@@ -1,0 +1,1 @@
+from dfs_tpu.api.http import make_http_handler  # noqa: F401

@@ -1,0 +1,180 @@
+"""External HTTP/1.1 API (L5) over asyncio streams.
+
+Route parity with the reference's external surface (StorageNode.java:71-89):
+
+    GET  /status            → 200 "OK"                  (:71-74)
+    GET  /files             → JSON file list             (:364-393)
+    POST /upload?name=…     → 201 JSON {fileId,…}        (:118-189)
+    GET  /download?fileId=… → bytes + Content-Disposition (:399-461)
+
+plus new surface the reference lacks: GET /metrics (counters), GET
+/manifest?fileId=… and DELETE /files?fileId=… (SURVEY.md §2.5(5)).
+
+Fixed reference defects: query strings are URL-decoded (the reference's
+parseQuery never decodes, StorageNode.java:521-533, while its client encodes —
+§2.5(3)); status lines carry real reason phrases (the reference always says
+"OK", even on errors, :562); missing Content-Length on POST → 411 (:118-189).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import TYPE_CHECKING
+from urllib.parse import parse_qs, unquote, urlsplit
+
+if TYPE_CHECKING:
+    from dfs_tpu.node.runtime import StorageNodeServer
+
+_REASONS = {200: "OK", 201: "Created", 400: "Bad Request", 404: "Not Found",
+            405: "Method Not Allowed", 411: "Length Required",
+            413: "Payload Too Large", 500: "Internal Server Error"}
+MAX_BODY = 4 * 1024 * 1024 * 1024
+
+
+def _resp(status: int, body: bytes, content_type: str,
+          extra: dict[str, str] | None = None) -> bytes:
+    head = [f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}",
+            f"Content-Type: {content_type}",
+            f"Content-Length: {len(body)}",
+            "Connection: close"]
+    for k, v in (extra or {}).items():
+        head.append(f"{k}: {v}")
+    return ("\r\n".join(head) + "\r\n\r\n").encode() + body
+
+
+def plain(status: int, text: str) -> bytes:
+    return _resp(status, text.encode(), "text/plain; charset=utf-8")
+
+
+def as_json(status: int, obj) -> bytes:
+    return _resp(status, json.dumps(obj).encode(), "application/json")
+
+
+def binary(status: int, data: bytes, filename: str) -> bytes:
+    # Content-Disposition download, reference StorageNode.java:460,592-601.
+    # Strip control characters (CR/LF would split the header — injection) and
+    # quotes before interpolating the user-supplied name into a header.
+    safe = "".join(c for c in filename if c >= " " and c != '"') or "download"
+    return _resp(status, data, "application/octet-stream",
+                 {"Content-Disposition": f'attachment; filename="{safe}"'})
+
+
+def make_http_handler(node: "StorageNodeServer"):
+    async def handler(reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            out = await _serve_one(node, reader)
+        except Exception as e:  # noqa: BLE001
+            node.log.warning("http error: %s", e)
+            out = plain(500, f"Internal error: {e}")
+        try:
+            writer.write(out)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    return handler
+
+
+async def _serve_one(node: "StorageNodeServer",
+                     reader: asyncio.StreamReader) -> bytes:
+    from dfs_tpu.node.runtime import (DownloadError, NotFoundError,
+                                      UploadError)
+
+    request_line = (await reader.readline()).decode("latin-1").strip()
+    if not request_line:
+        return plain(400, "Empty request")
+    parts = request_line.split(" ")
+    if len(parts) != 3:
+        return plain(400, "Malformed request line")
+    method, target, _version = parts
+    split = urlsplit(target)
+    path = unquote(split.path)
+    query = {k: v[0] for k, v in parse_qs(split.query).items()}
+
+    content_length: int | None = None
+    while True:
+        line = (await reader.readline()).decode("latin-1")
+        if line in ("\r\n", "\n", ""):
+            break
+        if ":" in line:
+            k, v = line.split(":", 1)
+            if k.strip().lower() == "content-length":
+                try:
+                    content_length = int(v.strip())
+                except ValueError:
+                    return plain(400, "Bad Content-Length")
+
+    node.counters.inc("http_requests")
+
+    if method == "GET" and path == "/status":
+        return plain(200, "OK")  # exact reference reply, StorageNode.java:73
+
+    if method == "GET" and path == "/files":
+        return as_json(200, node.list_files())
+
+    if method == "GET" and path == "/metrics":
+        snap = node.counters.snapshot()
+        snap["nodeId"] = node.cfg.node_id
+        snap["underReplicated"] = len(node.under_replicated)
+        return as_json(200, snap)
+
+    if method == "GET" and path == "/manifest":
+        file_id = query.get("fileId")
+        if not file_id:
+            return plain(400, "Missing fileId")
+        m = node.store.manifests.load(file_id)
+        if m is None:
+            return plain(404, "File not found")
+        return _resp(200, m.to_json().encode(), "application/json")
+
+    if method == "POST" and path == "/upload":
+        if content_length is None:
+            return plain(411, "Length Required")  # reference parity
+        if content_length > MAX_BODY:
+            return plain(413, "Payload Too Large")
+        data = await reader.readexactly(content_length)
+        try:
+            manifest, stats = await node.upload(data, query.get("name", ""))
+        except UploadError as e:
+            return plain(500, str(e))  # "Replication failed", :176
+        return as_json(201, {"fileId": manifest.file_id,
+                             "name": manifest.name,
+                             "size": manifest.size,
+                             "chunks": manifest.total_chunks, **stats})
+
+    if method == "GET" and path == "/download":
+        file_id = query.get("fileId")
+        if not file_id:
+            return plain(400, "Missing fileId")
+        try:
+            manifest, data = await node.download(file_id)
+        except NotFoundError:
+            return plain(404, "File not found")
+        except DownloadError as e:
+            return plain(500, str(e))
+        return binary(200, data, manifest.name)
+
+    if method == "POST" and path == "/repair":
+        # Operator-triggered re-replication (the serve loop also runs this
+        # periodically; the reference has no repair at all — SURVEY.md §5.3).
+        repaired = await node.repair_once()
+        return as_json(200, {"repaired": repaired,
+                             "underReplicated": len(node.under_replicated)})
+
+    if method == "DELETE" and path == "/files":
+        file_id = query.get("fileId")
+        if not file_id:
+            return plain(400, "Missing fileId")
+        found = await node.delete(file_id)
+        return plain(200 if found else 404,
+                     "Deleted" if found else "File not found")
+
+    return plain(404, "Not found")  # reference: unknown routes → 404, :107

@@ -1,0 +1,1 @@
+from dfs_tpu.cli.main import main  # noqa: F401

@@ -1,0 +1,69 @@
+"""Synchronous HTTP client — capability parity with client/src/Client.java.
+
+Same helper surface as the reference's C7 (httpGetString/httpGetBytes/
+httpPostString via HttpURLConnection with 5 s timeouts, Client.java:15,
+278-340), built on urllib. Unlike the reference it also parses real JSON
+instead of hand-scanning strings (Client.java:239-272)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass
+
+DEFAULT_TIMEOUT_S = 5.0  # reference: 5000 ms, Client.java:15
+
+
+@dataclass(frozen=True)
+class RemoteFile:
+    """Reference value type C8 (Client.java:19-27) + new metadata."""
+    file_id: str
+    name: str
+    size: int = 0
+    chunks: int = 0
+
+
+class NodeClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 5001,
+                 timeout_s: float = DEFAULT_TIMEOUT_S) -> None:
+        self.base = f"http://{host}:{port}"
+        self.timeout_s = timeout_s
+
+    def _request(self, method: str, path: str, body: bytes | None = None) -> bytes:
+        req = urllib.request.Request(self.base + path, data=body, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode("utf-8", "replace")
+            raise RuntimeError(f"HTTP {e.code}: {detail}") from e
+
+    def status(self) -> str:
+        return self._request("GET", "/status").decode()
+
+    def list_files(self) -> list[RemoteFile]:
+        items = json.loads(self._request("GET", "/files"))
+        return [RemoteFile(file_id=i["fileId"], name=i.get("name", i["fileId"]),
+                           size=i.get("size", 0), chunks=i.get("chunks", 0))
+                for i in items]
+
+    def upload(self, data: bytes, name: str) -> dict:
+        q = urllib.parse.urlencode({"name": name})
+        return json.loads(self._request("POST", f"/upload?{q}", body=data))
+
+    def download(self, file_id: str) -> bytes:
+        q = urllib.parse.urlencode({"fileId": file_id})
+        return self._request("GET", f"/download?{q}")
+
+    def manifest(self, file_id: str) -> dict:
+        q = urllib.parse.urlencode({"fileId": file_id})
+        return json.loads(self._request("GET", f"/manifest?{q}"))
+
+    def metrics(self) -> dict:
+        return json.loads(self._request("GET", "/metrics"))
+
+    def delete(self, file_id: str) -> str:
+        q = urllib.parse.urlencode({"fileId": file_id})
+        return self._request("DELETE", f"/files?{q}").decode()

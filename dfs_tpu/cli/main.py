@@ -1,0 +1,220 @@
+"""CLI — interactive menu parity with the reference client plus a scriptable
+mode (the reference's pure interactivity is why it has zero automated tests,
+SURVEY.md §4).
+
+Interactive menu reproduces Client.java:36-40 exactly:
+    0 Exit | 1 Test server | 2 List files | 3 Upload file | 4 Download file
+
+Scriptable subcommands: serve, status, list, upload, download, delete,
+metrics, repair.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+from pathlib import Path
+
+from dfs_tpu.cli.client import NodeClient
+from dfs_tpu.config import CDCParams, ClusterConfig, NodeConfig
+
+
+def _client(args) -> NodeClient:
+    return NodeClient(host=args.host, port=args.port)
+
+
+def cmd_serve(args) -> int:
+    from dfs_tpu.node.runtime import StorageNodeServer
+
+    cluster = ClusterConfig.localhost(
+        n_nodes=args.nodes, base_port=args.base_port,
+        base_internal_port=args.base_internal_port,
+        replication_factor=args.replication_factor)
+    cfg = NodeConfig(
+        node_id=args.node_id, cluster=cluster,
+        data_root=Path(args.data_root), fragmenter=args.fragmenter,
+        cdc=CDCParams(min_size=args.min_chunk, avg_size=args.avg_chunk,
+                      max_size=args.max_chunk))
+
+    async def run() -> None:
+        node = StorageNodeServer(cfg)
+        await node.start()
+        if args.repair_interval > 0:
+            async def repair_loop() -> None:
+                while True:
+                    await asyncio.sleep(args.repair_interval)
+                    try:
+                        n = await node.repair_once()
+                        if n:
+                            node.log.info("repair: re-replicated %d chunks", n)
+                    except Exception as e:  # noqa: BLE001
+                        node.log.warning("repair failed: %s", e)
+            asyncio.create_task(repair_loop())
+        await asyncio.Event().wait()  # serve forever
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_status(args) -> int:
+    print(_client(args).status())
+    return 0
+
+
+def cmd_list(args) -> int:
+    files = _client(args).list_files()
+    if not files:
+        print("(no files)")
+    for i, f in enumerate(files, 1):
+        print(f"{i}. {f.name}  id={f.file_id[:16]}…  "
+              f"size={f.size}  chunks={f.chunks}")
+    return 0
+
+
+def cmd_upload(args) -> int:
+    path = Path(args.file)
+    info = _client(args).upload(path.read_bytes(), name=path.name)
+    print(f"Uploaded: fileId={info['fileId']} chunks={info['chunks']} "
+          f"transferred={info.get('transferredBytes', '?')}B "
+          f"dedupSkipped={info.get('dedupSkippedBytes', '?')}B")
+    return 0
+
+
+def cmd_download(args) -> int:
+    c = _client(args)
+    file_id = args.file_id
+    data = c.download(file_id)
+    # Resolve the friendly name like the reference client (downloads/<name>,
+    # Client.java:214-219).
+    name = file_id
+    for f in c.list_files():
+        if f.file_id == file_id:
+            name = f.name
+            break
+    out = Path(args.out or "downloads") / name
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_bytes(data)
+    print(f"Saved {len(data)} bytes to {out}")
+    return 0
+
+
+def cmd_delete(args) -> int:
+    print(_client(args).delete(args.file_id))
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    import json
+    print(json.dumps(_client(args).metrics(), indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_menu(args) -> int:
+    """Interactive loop, Client.java:29-82 parity."""
+    while True:
+        print("\n=== Distributed File Storage (TPU) ===\n"
+              "0. Exit\n1. Test server\n2. List files\n"
+              "3. Upload file\n4. Download file")
+        try:
+            choice = input("> ").strip()
+        except EOFError:
+            return 0
+        try:
+            if choice == "0":
+                return 0
+            elif choice == "1":
+                args.port = _ask_port(args.port)
+                print(_client(args).status())
+            elif choice == "2":
+                args.port = _ask_port(args.port)
+                cmd_list(args)
+            elif choice == "3":
+                args.port = _ask_port(args.port)
+                directory = input("Directory [.]: ").strip() or "."
+                files = sorted(p for p in Path(directory).iterdir()
+                               if p.is_file())
+                if not files:
+                    print("(no files)")
+                    continue
+                for i, p in enumerate(files, 1):
+                    print(f"{i}. {p.name} ({p.stat().st_size} bytes)")
+                idx = int(input("File #: ")) - 1
+                args.file = str(files[idx])
+                cmd_upload(args)
+            elif choice == "4":
+                args.port = _ask_port(args.port)
+                files = _client(args).list_files()
+                for i, f in enumerate(files, 1):
+                    print(f"{i}. {f.name}")
+                if not files:
+                    print("(no files)")
+                    continue
+                idx = int(input("File #: ")) - 1
+                args.file_id = files[idx].file_id
+                args.out = None
+                cmd_download(args)
+            else:
+                print("Invalid option")
+        except Exception as e:  # noqa: BLE001 - per-iteration catch, Client.java:77-80
+            print(f"Error: {e}")
+
+
+def _ask_port(default: int) -> int:
+    """Port prompt with fallback, Client.java:226-237 parity."""
+    raw = input(f"Node port [{default}]: ").strip()
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="dfs-tpu", description="TPU-native distributed file storage")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=5001)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    serve = sub.add_parser("serve", help="run a storage node")
+    serve.add_argument("--node-id", type=int, required=True)
+    serve.add_argument("--nodes", type=int, default=5)
+    serve.add_argument("--base-port", type=int, default=5001)
+    serve.add_argument("--base-internal-port", type=int, default=6001)
+    serve.add_argument("--replication-factor", type=int, default=2)
+    serve.add_argument("--data-root", default="data")
+    serve.add_argument("--fragmenter", default="cdc",
+                       choices=["fixed", "cdc", "cdc-tpu"])
+    serve.add_argument("--min-chunk", type=int, default=2048)
+    serve.add_argument("--avg-chunk", type=int, default=8192)
+    serve.add_argument("--max-chunk", type=int, default=65536)
+    serve.add_argument("--repair-interval", type=float, default=30.0)
+    serve.set_defaults(fn=cmd_serve)
+
+    sub.add_parser("status").set_defaults(fn=cmd_status)
+    sub.add_parser("list").set_defaults(fn=cmd_list)
+    up = sub.add_parser("upload")
+    up.add_argument("file")
+    up.set_defaults(fn=cmd_upload)
+    down = sub.add_parser("download")
+    down.add_argument("file_id")
+    down.add_argument("--out", default=None)
+    down.set_defaults(fn=cmd_download)
+    rm = sub.add_parser("delete")
+    rm.add_argument("file_id")
+    rm.set_defaults(fn=cmd_delete)
+    sub.add_parser("metrics").set_defaults(fn=cmd_metrics)
+    sub.add_parser("menu").set_defaults(fn=cmd_menu)
+    return ap
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

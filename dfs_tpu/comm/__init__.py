@@ -1,0 +1,2 @@
+from dfs_tpu.comm.wire import read_msg, send_msg  # noqa: F401
+from dfs_tpu.comm.rpc import InternalClient  # noqa: F401

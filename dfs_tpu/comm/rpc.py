@@ -1,0 +1,101 @@
+"""Async client for the internal storage plane.
+
+Fulfils the roles of the reference's outbound peer calls
+(HttpURLConnection at StorageNode.java:226-259, 313-350, 471-483) with the
+same reliability envelope — per-attempt connect timeouts and bounded retries
+(reference: 2 s / 3 attempts, StorageNode.java:208,229-230) — but over the
+binary wire format and with connection reuse per request (the reference opens
+a fresh connection per call and pays Base64 inflation).
+
+Ops mirror the reference's internal API one-to-one:
+- store_chunks   ⇔ POST /internal/storeFragments (StorageNode.java:265-293),
+  including the hash-echo verification contract (:248-257): the receiver
+  recomputes sha256 of every chunk it wrote and echoes the digests.
+- announce       ⇔ POST /internal/announceFile  (StorageNode.java:299-311)
+- get_chunk      ⇔ GET  /internal/getFragment   (StorageNode.java:489-515)
+- get_manifest   — new: manifest fetch fallback (the reference silently loses
+  manifests announced while a node was down, SURVEY.md §5.3)
+- health         ⇔ GET /status (StorageNode.java:71-74)
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from dfs_tpu.comm.wire import pack_chunks, read_msg, send_msg, unpack_chunks
+from dfs_tpu.config import PeerAddr
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+class InternalClient:
+    def __init__(self, connect_timeout_s: float = 2.0,
+                 request_timeout_s: float = 10.0, retries: int = 3) -> None:
+        self.connect_timeout_s = connect_timeout_s
+        self.request_timeout_s = request_timeout_s
+        self.retries = retries
+
+    async def _call_once(self, peer: PeerAddr, header: dict,
+                         body: bytes) -> tuple[dict, bytes]:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(peer.host, peer.internal_port),
+            timeout=self.connect_timeout_s)
+        try:
+            await asyncio.wait_for(send_msg(writer, header, body),
+                                   timeout=self.request_timeout_s)
+            resp, rbody = await asyncio.wait_for(
+                read_msg(reader), timeout=self.request_timeout_s)
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+        if not resp.get("ok", False):
+            raise RpcError(f"peer {peer.node_id} error: {resp.get('error')}")
+        return resp, rbody
+
+    async def call(self, peer: PeerAddr, header: dict,
+                   body: bytes = b"") -> tuple[dict, bytes]:
+        """Bounded-retry call (reference: 3 attempts, StorageNode.java:208)."""
+        last: Exception | None = None
+        for attempt in range(self.retries):
+            try:
+                return await self._call_once(peer, header, body)
+            except RpcError:
+                raise  # application-level error: retrying won't help
+            except (OSError, asyncio.TimeoutError, RuntimeError) as e:
+                last = e
+                if attempt + 1 < self.retries:
+                    await asyncio.sleep(0.05 * (attempt + 1))
+        raise RpcError(
+            f"peer {peer.node_id} unreachable after {self.retries} attempts: {last}")
+
+    # ---- typed ops ----
+
+    async def store_chunks(self, peer: PeerAddr, file_id: str,
+                           chunks: list[tuple[str, bytes]]) -> list[str]:
+        """Send chunks; returns the receiver's recomputed digests (hash echo,
+        reference contract StorageNode.java:248-257). Caller verifies."""
+        table, body = pack_chunks(chunks)
+        resp, _ = await self.call(
+            peer, {"op": "store_chunks", "fileId": file_id, "chunks": table}, body)
+        return list(resp.get("digests", []))
+
+    async def announce(self, peer: PeerAddr, manifest_json: str) -> None:
+        await self.call(peer, {"op": "announce", "manifest": manifest_json})
+
+    async def get_chunk(self, peer: PeerAddr, digest: str) -> bytes:
+        _, body = await self.call(peer, {"op": "get_chunk", "digest": digest})
+        return body
+
+    async def get_manifest(self, peer: PeerAddr, file_id: str) -> str | None:
+        resp, _ = await self.call(peer, {"op": "get_manifest", "fileId": file_id})
+        return resp.get("manifest")
+
+    async def health(self, peer: PeerAddr) -> dict[str, Any]:
+        resp, _ = await self.call(peer, {"op": "health"})
+        return resp

@@ -1,0 +1,78 @@
+"""Storage-plane wire format: length-prefixed JSON header + raw binary body.
+
+Replaces the reference's internal protocol — hand-built JSON with Base64
+fragment payloads over hand-parsed HTTP (StorageNode.java:629-642,657-773) —
+which inflates replication traffic ~33% and breaks on escaped quotes
+(SURVEY.md §2.5(6), S14). Frame layout::
+
+    magic   u32  0x44465301  ("DFS\\x01")
+    hdr_len u32  big-endian
+    body_len u64 big-endian
+    header  hdr_len bytes of UTF-8 JSON (op, params, chunk table …)
+    body    body_len raw bytes (chunk data, concatenated)
+
+Chunk batches put (digest, length) pairs in the header and concatenate the
+raw chunk bytes in the body — zero encoding overhead.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+
+MAGIC = 0x44465301
+_PREFIX = struct.Struct(">IIQ")
+MAX_HEADER = 64 * 1024 * 1024
+MAX_BODY = 8 * 1024 * 1024 * 1024
+
+
+class WireError(RuntimeError):
+    pass
+
+
+async def send_msg(writer: asyncio.StreamWriter, header: dict,
+                   body: bytes = b"") -> None:
+    h = json.dumps(header, separators=(",", ":")).encode()
+    writer.write(_PREFIX.pack(MAGIC, len(h), len(body)))
+    writer.write(h)
+    if body:
+        writer.write(body)
+    await writer.drain()
+
+
+async def read_msg(reader: asyncio.StreamReader) -> tuple[dict, bytes]:
+    try:
+        prefix = await reader.readexactly(_PREFIX.size)
+    except asyncio.IncompleteReadError as e:
+        raise WireError("connection closed mid-frame") from e
+    magic, hdr_len, body_len = _PREFIX.unpack(prefix)
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic:#x}")
+    if hdr_len > MAX_HEADER or body_len > MAX_BODY:
+        raise WireError("frame too large")
+    try:
+        header = json.loads(await reader.readexactly(hdr_len))
+        body = await reader.readexactly(body_len) if body_len else b""
+    except asyncio.IncompleteReadError as e:
+        raise WireError("connection closed mid-frame") from e
+    return header, body
+
+
+def pack_chunks(chunks: list[tuple[str, bytes]]) -> tuple[list[dict], bytes]:
+    """[(digest, data)] → (header chunk table, concatenated body)."""
+    table = [{"digest": d, "length": len(b)} for d, b in chunks]
+    return table, b"".join(b for _, b in chunks)
+
+
+def unpack_chunks(table: list[dict], body: bytes) -> list[tuple[str, bytes]]:
+    out, off = [], 0
+    for entry in table:
+        ln = int(entry["length"])
+        if off + ln > len(body):
+            raise WireError("chunk table overruns body")
+        out.append((entry["digest"], body[off:off + ln]))
+        off += ln
+    if off != len(body):
+        raise WireError("body has trailing bytes")
+    return out

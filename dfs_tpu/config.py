@@ -1,0 +1,147 @@
+"""Typed configuration for the whole framework.
+
+Replaces the reference's two positional CLI args plus hardcoded constants
+(``TOTAL_NODES = 5`` at StorageNode.java:15, the ``localhost:500<id>`` peer URL
+scheme at StorageNode.java:227/322/472, and the 2000 ms timeouts at
+StorageNode.java:229-230) with one explicit, serializable config. This fixes
+reference defects SURVEY.md §2.5(1): cluster size/addressing are no longer
+hardwired and node ids >= 10 work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+# uint32 Gear hash with shift-1 forgets bytes older than 32 positions (they
+# shift out mod 2**32): the effective window, and the halo threaded between
+# stream tiles / exchanged between sp-ring neighbors. Defined here (jax-free)
+# so CPU-only deployments never import jax.
+GEAR_WINDOW = 32
+GEAR_HALO = GEAR_WINDOW - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class CDCParams:
+    """Content-defined-chunking parameters (Gear rolling hash).
+
+    ``avg_size`` must be a power of two: the boundary test is
+    ``(gear_hash & (avg_size - 1)) == 0`` which fires with probability
+    1/avg_size per byte. ``window`` is fixed at 32 because the uint32 Gear
+    hash with shift-1 forgets bytes older than 32 positions (they shift out
+    mod 2**32) — this is what makes the TPU bitmap computation exactly equal
+    to the sequential CPU rolling hash.
+    """
+
+    min_size: int = 2048
+    avg_size: int = 8192
+    max_size: int = 65536
+    seed: int = 0x9E3779B9
+
+    WINDOW: int = dataclasses.field(default=32, init=False, repr=False)
+
+    def __post_init__(self) -> None:
+        if self.avg_size & (self.avg_size - 1):
+            raise ValueError(f"avg_size must be a power of two, got {self.avg_size}")
+        if not (0 < self.min_size <= self.avg_size <= self.max_size):
+            raise ValueError(
+                f"need 0 < min ({self.min_size}) <= avg ({self.avg_size})"
+                f" <= max ({self.max_size})"
+            )
+
+    @property
+    def mask(self) -> int:
+        return self.avg_size - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class PeerAddr:
+    """Explicit peer address — replaces the derived ``localhost:500<id>``
+    scheme (StorageNode.java:227) that broke for node ids >= 10."""
+
+    node_id: int
+    host: str
+    port: int           # external HTTP API port
+    internal_port: int  # binary storage-plane port
+
+    @property
+    def http_base(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterConfig:
+    """Cluster membership + replication policy.
+
+    The reference fixes replication at cyclic x2 over 5 nodes
+    (StorageNode.java:143-145,199-200). Here both the node list and the
+    replication factor are explicit.
+    """
+
+    peers: tuple[PeerAddr, ...]
+    replication_factor: int = 2
+
+    def __post_init__(self) -> None:
+        if len({p.node_id for p in self.peers}) != len(self.peers):
+            raise ValueError("duplicate node_id in cluster config")
+        if not 1 <= self.replication_factor <= max(1, len(self.peers)):
+            raise ValueError("replication_factor out of range")
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.peers)
+
+    def peer(self, node_id: int) -> PeerAddr:
+        for p in self.peers:
+            if p.node_id == node_id:
+                return p
+        raise KeyError(f"unknown node_id {node_id}")
+
+    def sorted_ids(self) -> list[int]:
+        return sorted(p.node_id for p in self.peers)
+
+    @staticmethod
+    def localhost(n_nodes: int, base_port: int = 5001,
+                  base_internal_port: int = 6001,
+                  replication_factor: int = 2) -> "ClusterConfig":
+        """Convenience constructor mirroring the reference's manual recipe of
+        N localhost nodes on ports 5001..500N (run.txt:3-7) — but explicit."""
+        peers = tuple(
+            PeerAddr(node_id=i + 1, host="127.0.0.1",
+                     port=base_port + i, internal_port=base_internal_port + i)
+            for i in range(n_nodes)
+        )
+        return ClusterConfig(peers=peers, replication_factor=replication_factor)
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeConfig:
+    """Per-node runtime configuration."""
+
+    node_id: int
+    cluster: ClusterConfig
+    data_root: Path
+    fragmenter: str = "cdc"        # "fixed" | "cdc" | "cdc-tpu"
+    cdc: CDCParams = dataclasses.field(default_factory=CDCParams)
+    fixed_parts: int = 5           # FixedFragmenter part count (reference: TOTAL_NODES=5)
+    connect_timeout_s: float = 2.0  # reference: 2000 ms, StorageNode.java:229-230
+    request_timeout_s: float = 10.0
+    retries: int = 3               # reference: 3 attempts, StorageNode.java:208,320
+    # Write policy: the reference aborts the whole upload if ANY peer is down
+    # (StorageNode.java:218-221) — write-all. We default to quorum=1 remote
+    # copy with background repair (SURVEY.md §5.3 build note).
+    write_quorum: int = 1
+
+    @property
+    def self_addr(self) -> PeerAddr:
+        return self.cluster.peer(self.node_id)
+
+    def to_json(self) -> str:
+        def enc(o):
+            if dataclasses.is_dataclass(o) and not isinstance(o, type):
+                return dataclasses.asdict(o)
+            if isinstance(o, Path):
+                return str(o)
+            raise TypeError(type(o))
+        return json.dumps(self, default=enc, indent=2)

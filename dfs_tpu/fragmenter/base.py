@@ -1,0 +1,60 @@
+"""The Fragmenter plugin interface (north star, BASELINE.json).
+
+The reference hard-codes one strategy — split into ``TOTAL_NODES = 5``
+positional fragments (StorageNode.java:15,138-171). Here fragmentation is a
+plugin: the node runtime calls ``chunk(data)`` and gets back content-addressed
+chunk metadata; everything downstream (manifest, placement, replication,
+download, dedup) is strategy-agnostic.
+
+Implementations:
+- FixedFragmenter   — reference-equivalent positional split (CPU).
+- CpuCdcFragmenter  — Gear-hash content-defined chunking, NumPy (the oracle).
+- TpuCdcFragmenter  — the same chunking as batched JAX/XLA TPU kernels.
+"""
+
+from __future__ import annotations
+
+import abc
+
+from dfs_tpu.meta.manifest import ChunkRef, Manifest
+from dfs_tpu.utils.hashing import sha256_hex
+
+
+class Fragmenter(abc.ABC):
+    """Splits a byte stream into content-addressed chunks."""
+
+    name: str = "abstract"
+
+    @abc.abstractmethod
+    def chunk(self, data: bytes) -> list[ChunkRef]:
+        """Return the chunk list covering ``data`` exactly, in order, with
+        per-chunk sha256 digests."""
+
+    def manifest(self, data: bytes, name: str,
+                 file_id: str | None = None) -> Manifest:
+        """Build the manifest for ``data``: file_id = sha256(bytes) exactly as
+        the reference (StorageNode.java:127), chunks from this strategy."""
+        return Manifest(
+            file_id=file_id or sha256_hex(data),
+            name=name,
+            size=len(data),
+            fragmenter=self.name,
+            chunks=tuple(self.chunk(data)),
+        )
+
+
+def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5) -> Fragmenter:
+    """Factory keyed by NodeConfig.fragmenter."""
+    from dfs_tpu.config import CDCParams
+    from dfs_tpu.fragmenter.cdc_cpu import CpuCdcFragmenter
+    from dfs_tpu.fragmenter.cdc_tpu import TpuCdcFragmenter
+    from dfs_tpu.fragmenter.fixed import FixedFragmenter
+
+    params = cdc_params or CDCParams()
+    if kind == "fixed":
+        return FixedFragmenter(parts=fixed_parts)
+    if kind == "cdc":
+        return CpuCdcFragmenter(params)
+    if kind == "cdc-tpu":
+        return TpuCdcFragmenter(params)
+    raise ValueError(f"unknown fragmenter {kind!r}")

@@ -1,0 +1,38 @@
+"""FixedFragmenter — reference-equivalent positional split.
+
+Reproduces the reference's split semantics exactly (StorageNode.java:138-155):
+``baseSize = total / parts``; the first ``total % parts`` fragments get one
+extra byte; tiny files yield zero-byte fragments (SURVEY.md §2.5(8)). Unlike
+the reference — which computes per-fragment hashes (StorageNode.java:159) and
+then drops them from the manifest (SURVEY.md §2.5(7)) — the digests are kept.
+"""
+
+from __future__ import annotations
+
+from dfs_tpu.fragmenter.base import Fragmenter
+from dfs_tpu.meta.manifest import ChunkRef
+from dfs_tpu.utils.hashing import sha256_many_hex
+
+
+class FixedFragmenter(Fragmenter):
+    name = "fixed"
+
+    def __init__(self, parts: int = 5) -> None:
+        if parts < 1:
+            raise ValueError("parts must be >= 1")
+        self.parts = parts
+
+    def chunk(self, data: bytes) -> list[ChunkRef]:
+        total = len(data)
+        base, rem = divmod(total, self.parts)
+        sizes = [base + 1] * rem + [base] * (self.parts - rem)
+        pieces, offset = [], 0
+        for size in sizes:
+            pieces.append(data[offset:offset + size])
+            offset += size
+        digests = sha256_many_hex(pieces)
+        out, offset = [], 0
+        for i, (size, digest) in enumerate(zip(sizes, digests)):
+            out.append(ChunkRef(index=i, offset=offset, length=size, digest=digest))
+            offset += size
+        return out

@@ -1,0 +1,1 @@
+from dfs_tpu.meta.manifest import ChunkRef, Manifest  # noqa: F401

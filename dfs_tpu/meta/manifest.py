@@ -1,0 +1,80 @@
+"""Manifest v2 — chunk-granular file metadata.
+
+The reference manifest is ``{fileId, originalName, totalFragments}`` built by
+string concatenation (StorageNode.java:620-626) and parsed with ``indexOf``
+hacks (StorageNode.java:657-773). Two deliberate upgrades (SURVEY.md §2.5(7)):
+
+1. per-chunk SHA-256 digests + (offset, length) are stored in the manifest, so
+   download can verify every chunk independently and the dedup index can
+   address chunks by content — the reference computes fragment hashes
+   (StorageNode.java:159) but throws them away;
+2. serialization is real JSON (stdlib), not a hand-rolled codec that breaks on
+   escaped quotes (reference defect, SURVEY.md S14).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkRef:
+    """One content-addressed chunk of a file."""
+
+    index: int
+    offset: int
+    length: int
+    digest: str  # lowercase-hex sha256 of the chunk bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class Manifest:
+    """Whole-file metadata. ``file_id`` remains sha256(file bytes) exactly as
+    in the reference (StorageNode.java:127), preserving whole-file dedup."""
+
+    file_id: str
+    name: str
+    size: int
+    fragmenter: str               # "fixed" | "cdc" | "cdc-tpu"
+    chunks: tuple[ChunkRef, ...]
+
+    def __post_init__(self) -> None:
+        covered = 0
+        for i, c in enumerate(self.chunks):
+            if c.index != i:
+                raise ValueError(f"chunk index mismatch at {i}")
+            if c.offset != covered:
+                raise ValueError(f"chunk offset gap at {i}")
+            covered += c.length
+        if covered != self.size:
+            raise ValueError(f"chunks cover {covered} bytes, size is {self.size}")
+
+    @property
+    def total_chunks(self) -> int:
+        return len(self.chunks)
+
+    def digests(self) -> list[str]:
+        return [c.digest for c in self.chunks]
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "version": 2,
+            "fileId": self.file_id,
+            "originalName": self.name,
+            "size": self.size,
+            "fragmenter": self.fragmenter,
+            "totalFragments": len(self.chunks),  # reference-compat field name
+            "chunks": [dataclasses.asdict(c) for c in self.chunks],
+        }, indent=None, separators=(",", ":"))
+
+    @staticmethod
+    def from_json(text: str | bytes) -> "Manifest":
+        d = json.loads(text)
+        return Manifest(
+            file_id=d["fileId"],
+            name=d.get("originalName", d["fileId"]),
+            size=d["size"],
+            fragmenter=d.get("fragmenter", "fixed"),
+            chunks=tuple(ChunkRef(**c) for c in d["chunks"]),
+        )

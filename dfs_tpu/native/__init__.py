@@ -1,0 +1,101 @@
+"""ctypes loader for the native CPU core (cdc_core.cpp).
+
+Compiles on first use with g++ (cached as cdc_core.so next to the source; no
+pybind11 in the image, so the binding is plain ctypes over an extern-C ABI).
+Every entry point degrades gracefully to pure Python/NumPy when the toolchain
+is unavailable — the framework never *requires* the native library.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+_DIR = Path(__file__).resolve().parent
+_SRC = _DIR / "cdc_core.cpp"
+_SO = _DIR / "cdc_core.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+             str(_SRC), "-o", str(_SO)],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """Load (building if needed) the native library, or None."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(str(_SO))
+        except OSError:
+            return None
+        lib.dfs_sha256_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p]
+        lib.dfs_sha256_batch.restype = None
+        lib.dfs_gear_cuts.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+            ctypes.c_uint32, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_uint64]
+        lib.dfs_gear_cuts.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def native_sha256_many(chunks: list[bytes]) -> list[str] | None:
+    """Batch sha256 via the native lib; None if unavailable (caller falls
+    back to hashlib)."""
+    lib = get_lib()
+    if lib is None or not chunks:
+        return None if lib is None else []
+    data = b"".join(chunks)
+    offsets = np.zeros(len(chunks) + 1, dtype=np.uint64)
+    np.cumsum([len(c) for c in chunks], out=offsets[1:])
+    out = np.empty(len(chunks) * 32, dtype=np.uint8)
+    buf = np.frombuffer(data, dtype=np.uint8) if data else np.empty(0, np.uint8)
+    lib.dfs_sha256_batch(
+        buf.ctypes.data if buf.size else None,
+        offsets.ctypes.data, len(chunks), out.ctypes.data)
+    raw = out.tobytes()
+    return [raw[32 * i:32 * (i + 1)].hex() for i in range(len(chunks))]
+
+
+def native_gear_cuts(data: bytes | np.ndarray, table: np.ndarray, mask: int,
+                     min_size: int, max_size: int) -> np.ndarray | None:
+    """Sequential CDC cut selection in C++; None if unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    arr = np.frombuffer(data, dtype=np.uint8) if isinstance(
+        data, (bytes, bytearray, memoryview)) else data
+    n = arr.shape[0]
+    if n == 0:
+        return np.zeros(0, dtype=np.uint64)
+    cap = n // min_size + 2
+    cuts = np.empty(cap, dtype=np.uint64)
+    table32 = np.ascontiguousarray(table, dtype=np.uint32)
+    wrote = lib.dfs_gear_cuts(arr.ctypes.data, n, table32.ctypes.data,
+                              mask, min_size, max_size,
+                              cuts.ctypes.data, cap)
+    if wrote < 0:
+        return None
+    return cuts[:wrote].astype(np.int64)

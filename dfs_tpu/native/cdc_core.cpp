@@ -1,0 +1,129 @@
+// Native CPU core: SHA-256 + Gear rolling-hash CDC.
+//
+// Role (SURVEY.md §2, "native equivalents"): the reference is pure Java with
+// zero native code; in this framework the TPU owns the hot path
+// (dfs_tpu/ops), and this C++ library is the node runtime's *host* engine —
+// used when no accelerator is attached (pure-CPU storage nodes), for the
+// hash-echo recomputation on the receive path, and as a fast oracle for
+// tests/benchmarks. Exposed to Python via ctypes (no pybind11 in the image).
+//
+// Build: dfs_tpu/native/build.py  (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+constexpr uint32_t K[64] = {
+    0x428a2f98u, 0x71374491u, 0xb5c0fbcfu, 0xe9b5dba5u, 0x3956c25bu,
+    0x59f111f1u, 0x923f82a4u, 0xab1c5ed5u, 0xd807aa98u, 0x12835b01u,
+    0x243185beu, 0x550c7dc3u, 0x72be5d74u, 0x80deb1feu, 0x9bdc06a7u,
+    0xc19bf174u, 0xe49b69c1u, 0xefbe4786u, 0x0fc19dc6u, 0x240ca1ccu,
+    0x2de92c6fu, 0x4a7484aau, 0x5cb0a9dcu, 0x76f988dau, 0x983e5152u,
+    0xa831c66du, 0xb00327c8u, 0xbf597fc7u, 0xc6e00bf3u, 0xd5a79147u,
+    0x06ca6351u, 0x14292967u, 0x27b70a85u, 0x2e1b2138u, 0x4d2c6dfcu,
+    0x53380d13u, 0x650a7354u, 0x766a0abbu, 0x81c2c92eu, 0x92722c85u,
+    0xa2bfe8a1u, 0xa81a664bu, 0xc24b8b70u, 0xc76c51a3u, 0xd192e819u,
+    0xd6990624u, 0xf40e3585u, 0x106aa070u, 0x19a4c116u, 0x1e376c08u,
+    0x2748774cu, 0x34b0bcb5u, 0x391c0cb3u, 0x4ed8aa4au, 0x5b9cca4fu,
+    0x682e6ff3u, 0x748f82eeu, 0x78a5636fu, 0x84c87814u, 0x8cc70208u,
+    0x90befffau, 0xa4506cebu, 0xbef9a3f7u, 0xc67178f2u};
+
+inline uint32_t rotr(uint32_t x, int n) {
+  return (x >> n) | (x << (32 - n));
+}
+
+void compress(uint32_t state[8], const uint8_t* block) {
+  uint32_t w[64];
+  for (int t = 0; t < 16; ++t) {
+    w[t] = (uint32_t(block[4 * t]) << 24) | (uint32_t(block[4 * t + 1]) << 16) |
+           (uint32_t(block[4 * t + 2]) << 8) | uint32_t(block[4 * t + 3]);
+  }
+  for (int t = 16; t < 64; ++t) {
+    uint32_t s0 = rotr(w[t - 15], 7) ^ rotr(w[t - 15], 18) ^ (w[t - 15] >> 3);
+    uint32_t s1 = rotr(w[t - 2], 17) ^ rotr(w[t - 2], 19) ^ (w[t - 2] >> 10);
+    w[t] = w[t - 16] + s0 + w[t - 7] + s1;
+  }
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+  uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+  for (int t = 0; t < 64; ++t) {
+    uint32_t s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + s1 + ch + K[t] + w[t];
+    uint32_t s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = s0 + maj;
+    h = g; g = f; f = e; e = d + t1; d = c; c = b; b = a; a = t1 + t2;
+  }
+  state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+  state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+}  // namespace
+
+extern "C" {
+
+// SHA-256 of one message; out = 32 raw bytes.
+void dfs_sha256(const uint8_t* data, uint64_t len, uint8_t* out) {
+  uint32_t st[8] = {0x6a09e667u, 0xbb67ae85u, 0x3c6ef372u, 0xa54ff53au,
+                    0x510e527fu, 0x9b05688cu, 0x1f83d9abu, 0x5be0cd19u};
+  uint64_t full = len / 64;
+  for (uint64_t i = 0; i < full; ++i) compress(st, data + 64 * i);
+  uint8_t tail[128];
+  uint64_t rem = len - 64 * full;
+  std::memset(tail, 0, sizeof(tail));
+  std::memcpy(tail, data + 64 * full, rem);
+  tail[rem] = 0x80;
+  uint64_t tail_blocks = (rem + 9 <= 64) ? 1 : 2;
+  uint64_t bits = len * 8;
+  for (int i = 0; i < 8; ++i)
+    tail[tail_blocks * 64 - 1 - i] = uint8_t(bits >> (8 * i));
+  compress(st, tail);
+  if (tail_blocks == 2) compress(st, tail + 64);
+  for (int i = 0; i < 8; ++i) {
+    out[4 * i] = uint8_t(st[i] >> 24);
+    out[4 * i + 1] = uint8_t(st[i] >> 16);
+    out[4 * i + 2] = uint8_t(st[i] >> 8);
+    out[4 * i + 3] = uint8_t(st[i]);
+  }
+}
+
+// Batch: messages concatenated in `data`, offsets[i]..offsets[i+1] per
+// message (offsets has n+1 entries); out = n * 32 bytes.
+void dfs_sha256_batch(const uint8_t* data, const uint64_t* offsets,
+                      uint64_t n, uint8_t* out) {
+  for (uint64_t i = 0; i < n; ++i)
+    dfs_sha256(data + offsets[i], offsets[i + 1] - offsets[i], out + 32 * i);
+}
+
+// Sequential Gear CDC cut selection (the same algorithm as
+// dfs_tpu/ops/boundary.py): writes exclusive cut offsets into `cuts`
+// (capacity cuts_cap), returns the number written, or -1 on overflow.
+// table: 256 uint32 Gear entries; boundary iff (h & mask)==0 at
+// length>=min_size; forced cut at max_size.
+int64_t dfs_gear_cuts(const uint8_t* data, uint64_t len,
+                      const uint32_t* table, uint32_t mask,
+                      uint64_t min_size, uint64_t max_size,
+                      uint64_t* cuts, uint64_t cuts_cap) {
+  uint32_t h = 0;
+  uint64_t start = 0, n_cuts = 0;
+  for (uint64_t i = 0; i < len; ++i) {
+    h = (h << 1) + table[data[i]];
+    uint64_t chunk_len = i - start + 1;
+    bool cut = (chunk_len >= min_size && (h & mask) == 0) ||
+               chunk_len >= max_size;
+    if (cut) {
+      if (n_cuts == cuts_cap) return -1;
+      cuts[n_cuts++] = i + 1;
+      start = i + 1;
+    }
+  }
+  if (start < len) {
+    if (n_cuts == cuts_cap) return -1;
+    cuts[n_cuts++] = len;
+  }
+  return int64_t(n_cuts);
+}
+
+}  // extern "C"

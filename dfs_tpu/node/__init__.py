@@ -1,0 +1,2 @@
+from dfs_tpu.node.placement import replica_set  # noqa: F401
+from dfs_tpu.node.runtime import StorageNodeServer  # noqa: F401

@@ -1,0 +1,23 @@
+"""Chunk placement: content-derived cyclic replica sets.
+
+The reference places by *position*: node i holds fragments i and (i+1) mod N
+(StorageNode.java:143-145,199-200) — every node must exist for every upload,
+and placement says nothing about content. Here the replica set is derived from
+the chunk digest itself: the primary is ``int(digest[:16], 16) mod N`` over the
+sorted node list and the remaining replicas follow cyclically, preserving the
+reference's cyclic-×2 redundancy geometry (README.md:65-66) while making
+placement deterministic from content alone — any node can compute, for any
+chunk, exactly who should hold it (no manifest needed for repair).
+"""
+
+from __future__ import annotations
+
+
+def replica_set(digest: str, node_ids: list[int], rf: int) -> list[int]:
+    """Deterministic replica node-ids for a chunk digest. ``node_ids`` must be
+    the same sorted membership list on every node."""
+    if not node_ids:
+        raise ValueError("empty cluster")
+    rf = min(rf, len(node_ids))
+    start = int(digest[:16], 16) % len(node_ids)
+    return [node_ids[(start + j) % len(node_ids)] for j in range(rf)]

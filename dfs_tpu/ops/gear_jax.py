@@ -1,0 +1,88 @@
+"""Gear rolling-hash boundary-candidate bitmap as a JAX kernel.
+
+The reference splits positionally (fixed N fragments, StorageNode.java:138-171);
+the north star replaces that with content-defined chunking. The sequential
+recurrence is ``h_i = (h_{i-1} << 1) + G[b_i]  (mod 2**32)``, and a position is
+a boundary *candidate* iff ``h_i & mask == 0``.
+
+The TPU trick (SURVEY.md §5.7): because each shift-left discards one high bit,
+``h_i`` depends on exactly the last 32 bytes::
+
+    h_i = sum_{k=0}^{31} G[b_{i-k}] << k   (mod 2**32)
+
+so the candidate bitmap is *embarrassingly parallel* — 32 shifted adds of the
+gathered Gear values — and agrees bit-for-bit with the sequential CPU rolling
+hash. Streams are processed in fixed-size tiles; the only cross-tile state is
+the previous tile's last 31 Gear values (the halo), which the host threads
+through tile calls (single-chip) or ``ppermute`` exchanges over ICI
+(multi-chip, see dfs_tpu.parallel).
+
+Chunk *selection* (greedy min/max-size walk over candidates) is metadata-sized
+and runs on the host — see dfs_tpu.ops.boundary.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dfs_tpu.config import GEAR_HALO as HALO  # noqa: F401  (re-export)
+from dfs_tpu.config import GEAR_WINDOW as WINDOW  # noqa: F401
+
+
+def gear_values(data: jax.Array, table: jax.Array) -> jax.Array:
+    """Per-byte Gear table lookup. data: [N] uint8, table: [256] uint32."""
+    return jnp.take(table, data.astype(jnp.int32), axis=0)
+
+
+def gear_bitmap_tile(data: jax.Array, prev_g: jax.Array,
+                     table: jax.Array, mask: jax.Array
+                     ) -> tuple[jax.Array, jax.Array]:
+    """Candidate bitmap for one tile.
+
+    data:   [N] uint8   — this tile's bytes.
+    prev_g: [31] uint32 — Gear values of the 31 bytes preceding the tile
+                          (zeros at stream start: absent bytes contribute 0,
+                          exactly like rolling from h=0).
+    table:  [256] uint32; mask: uint32 scalar (avg_size - 1).
+
+    Returns (bitmap [N] bool, tail_g [31] uint32) where tail_g seeds the next
+    tile. Requires N >= 31.
+    """
+    n = data.shape[0]
+    g = gear_values(data, table)
+    gp = jnp.concatenate([prev_g, g])  # [N + 31]
+    h = jnp.zeros((n,), jnp.uint32)
+    for k in range(WINDOW):
+        h = h + (jax.lax.slice(gp, (HALO - k,), (HALO - k + n,)) << np.uint32(k))
+    return (h & mask) == 0, gp[-HALO:]
+
+
+def make_gear_tile_fn(table: np.ndarray, mask: int, tile: int):
+    """Jit-compiled tile kernel closed over the table, for host-driven
+    streaming: ``fn(data_u8[tile], prev_g[31]) -> (bitmap[tile], tail_g[31])``."""
+    table_j = jnp.asarray(table, dtype=jnp.uint32)
+    mask_j = jnp.uint32(mask)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def fn(data: jax.Array, prev_g: jax.Array):
+        assert data.shape == (tile,)
+        return gear_bitmap_tile(data, prev_g, table_j, mask_j)
+
+    return fn
+
+
+def gear_hashes_dense(data: jax.Array, prev_g: jax.Array,
+                      table: jax.Array) -> jax.Array:
+    """Full uint32 hash per position (not just the bitmap) — used by tests to
+    compare against the sequential CPU oracle."""
+    n = data.shape[0]
+    g = gear_values(data, table)
+    gp = jnp.concatenate([prev_g, g])
+    h = jnp.zeros((n,), jnp.uint32)
+    for k in range(WINDOW):
+        h = h + (jax.lax.slice(gp, (HALO - k,), (HALO - k + n,)) << np.uint32(k))
+    return h

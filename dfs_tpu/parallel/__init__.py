@@ -1,0 +1,2 @@
+from dfs_tpu.parallel.mesh import make_mesh  # noqa: F401
+from dfs_tpu.parallel.sharded_cdc import make_sharded_step  # noqa: F401

@@ -1,0 +1,37 @@
+"""Device-mesh construction for the distributed CDC pipeline.
+
+The reference's only 'distribution' is point-to-point HTTP between JVMs
+(SURVEY.md §2.3, §5.8). The TPU-native compute plane instead scales over a
+``jax.sharding.Mesh`` with two axes:
+
+- ``dp`` (data parallel): independent byte streams (files/uploads) — the
+  analogue of the reference serving concurrent uploads on different nodes;
+- ``sp`` (sequence parallel): one long stream tiled across devices, with the
+  31-byte Gear halo exchanged between ring neighbors over ICI — the
+  long-context story from SURVEY.md §5.7 (ring-attention-shaped, but the
+  exchanged state is the rolling-hash window, not KV blocks).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: int | None = None, dp: int | None = None) -> Mesh:
+    """Mesh with axes ('dp', 'sp') over the first ``n_devices`` devices.
+
+    ``dp`` defaults to 2 when the device count is even and > 1 (so both axes
+    are exercised), else 1.
+    """
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, have {len(devs)}")
+    if dp is None:
+        dp = 2 if n % 2 == 0 and n > 1 else 1
+    if n % dp:
+        raise ValueError(f"dp={dp} does not divide n={n}")
+    arr = np.asarray(devs[:n]).reshape(dp, n // dp)
+    return Mesh(arr, axis_names=("dp", "sp"))

@@ -1,0 +1,92 @@
+"""Multi-device CDC pipeline: shard_map over a ('dp','sp') mesh.
+
+This is the framework's 'training step' analogue — the full device-side
+upload computation, jitted once over the mesh:
+
+- **sp axis (sequence parallelism / long-context):** each row of the input is
+  a byte stream tiled across the sp axis. The Gear window straddles tile
+  borders, so each device sends its tile's last 31 Gear values to its right
+  ring neighbor via ``lax.ppermute`` over ICI (SURVEY.md §5.7 — the
+  ring-attention-shaped neighbor exchange, with rolling-hash state instead of
+  KV blocks). Device 0 receives zeros ≡ stream start.
+- **dp axis (data parallelism):** independent streams (concurrent uploads)
+  ride the other mesh axis — the batch of padded chunks for SHA-256 is
+  sharded over the *flattened* ('dp','sp') axes so every device hashes an
+  equal slice.
+- a ``psum`` over both axes reduces the global candidate count (cheap stats
+  used by the node runtime for chunk-size telemetry).
+
+Contrast with the reference: its scale-out is N JVMs exchanging Base64 JSON
+over localhost HTTP (StorageNode.java:226-259); here the same byte-level work
+is one SPMD program with XLA collectives on ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from dfs_tpu.ops.gear_jax import HALO, WINDOW
+from dfs_tpu.ops.sha256_jax import _sha256_blocks_impl
+
+
+def _rowwise_gear_bitmap(data: jax.Array, prev_g: jax.Array,
+                         table: jax.Array, mask: jax.Array) -> jax.Array:
+    """data: [B, S] uint8; prev_g: [B, 31] uint32 (halo per row)."""
+    bsz, s = data.shape
+    g = jnp.take(table, data.astype(jnp.int32), axis=0)
+    gp = jnp.concatenate([prev_g, g], axis=1)  # [B, S+31]
+    h = jnp.zeros((bsz, s), jnp.uint32)
+    for k in range(WINDOW):
+        h = h + (jax.lax.slice_in_dim(gp, HALO - k, HALO - k + s, axis=1)
+                 << np.uint32(k))
+    return (h & mask) == 0
+
+
+def make_sharded_step(mesh: Mesh, table: np.ndarray, mask: int):
+    """Build the jitted multi-device step.
+
+    step(data [B, S] u8  — B sharded over dp, S tiled over sp,
+         words [H, L, 16] u32, nblocks [H] i32 — H sharded over (dp, sp))
+      -> (bitmap [B, S] bool  (same sharding as data),
+          digest_state [H, 8] uint32,
+          n_candidates [] int32  (global psum))
+    """
+    table_j = jnp.asarray(table, dtype=jnp.uint32)
+    mask_j = jnp.uint32(mask)
+    sp_size = mesh.shape["sp"]
+
+    def local_step(data, words, nblocks):
+        # halo exchange along the sp ring: my last 31 gear values feed my
+        # right neighbor's window; the first tile rolls from h=0 (zeros).
+        g_tail = jnp.take(table_j, data[:, -HALO:].astype(jnp.int32), axis=0)
+        prev_g = jax.lax.ppermute(
+            g_tail, "sp", [(i, i + 1) for i in range(sp_size - 1)])
+        bitmap = _rowwise_gear_bitmap(data, prev_g, table_j, mask_j)
+        state = _sha256_blocks_impl(words, nblocks)
+        n_cand = jax.lax.psum(
+            jax.lax.psum(jnp.sum(bitmap.astype(jnp.int32)), "sp"), "dp")
+        return bitmap, state, n_cand
+
+    shard_fn = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P("dp", "sp"), P(("dp", "sp")), P(("dp", "sp"))),
+        out_specs=(P("dp", "sp"), P(("dp", "sp")), P()),
+        check_vma=False,
+    )
+    return jax.jit(shard_fn)
+
+
+def shard_inputs(mesh: Mesh, data: np.ndarray, words: np.ndarray,
+                 nblocks: np.ndarray):
+    """device_put the step inputs with the matching NamedShardings."""
+    return (
+        jax.device_put(data, NamedSharding(mesh, P("dp", "sp"))),
+        jax.device_put(words, NamedSharding(mesh, P(("dp", "sp")))),
+        jax.device_put(nblocks, NamedSharding(mesh, P(("dp", "sp")))),
+    )

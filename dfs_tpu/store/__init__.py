@@ -1,0 +1,1 @@
+from dfs_tpu.store.cas import ChunkStore, ManifestStore, NodeStore  # noqa: F401

@@ -1,0 +1,157 @@
+"""Content-addressed local persistence (L0).
+
+Reference layout: ``data/node-<id>/<fileId>/manifest.json`` +
+``<fileId>/fragments/<i>.frag`` (StorageNode.java:20,147-149,352-357,463-469).
+That keys fragments by *position within one file*, so identical content in two
+files is stored twice.
+
+Here chunks are keyed purely by their sha256 digest —
+``chunks/<d[:2]>/<digest>`` — which makes cross-file dedup automatic: a chunk
+shared by two files (or two versions of one file) is stored once. Manifests
+live under ``files/<fileId>.json``. Writes go through a temp file + atomic
+rename, upgrading the reference's benign-race story (SURVEY.md §5.2: safety by
+idempotent overwrite) to actual atomicity; the manifest-last write ordering on
+upload (SURVEY.md §5.4) is preserved by the node runtime.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from pathlib import Path
+
+from dfs_tpu.meta.manifest import Manifest
+from dfs_tpu.utils.hashing import sha256_hex
+
+
+def _atomic_write(path: Path, data: bytes) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=".tmp-")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class ChunkStore:
+    """Flat content-addressed blob store."""
+
+    def __init__(self, root: Path) -> None:
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, digest: str) -> Path:
+        if len(digest) != 64 or any(c not in "0123456789abcdef" for c in digest):
+            raise ValueError(f"bad digest {digest!r}")
+        return self.root / digest[:2] / digest
+
+    def has(self, digest: str) -> bool:
+        return self._path(digest).is_file()
+
+    def put(self, digest: str, data: bytes, verify: bool = True) -> bool:
+        """Store a chunk. Returns False if it already existed (dedup hit).
+        Idempotent and safe under concurrent identical writes."""
+        p = self._path(digest)
+        if p.is_file():
+            return False
+        if verify and sha256_hex(data) != digest:
+            raise ValueError(f"data does not match digest {digest[:12]}…")
+        _atomic_write(p, data)
+        return True
+
+    def get(self, digest: str) -> bytes | None:
+        p = self._path(digest)
+        try:
+            return p.read_bytes()
+        except FileNotFoundError:
+            return None
+
+    def delete(self, digest: str) -> bool:
+        try:
+            self._path(digest).unlink()
+            return True
+        except FileNotFoundError:
+            return False
+
+    def digests(self) -> list[str]:
+        out = []
+        hexdigits = set("0123456789abcdef")
+        for sub in sorted(self.root.iterdir()) if self.root.is_dir() else []:
+            if sub.is_dir():
+                # filter strays (e.g. crash-leaked .tmp-* from _atomic_write)
+                out.extend(sorted(
+                    p.name for p in sub.iterdir()
+                    if len(p.name) == 64 and set(p.name) <= hexdigits))
+        return out
+
+    def total_bytes(self) -> int:
+        return sum((self.root / d[:2] / d).stat().st_size for d in self.digests())
+
+
+class ManifestStore:
+    """Per-node manifest directory; every node holds every manifest, exactly
+    like the reference's announce-to-all model (StorageNode.java:313-350)."""
+
+    def __init__(self, root: Path) -> None:
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, file_id: str) -> Path:
+        if len(file_id) != 64 or any(c not in "0123456789abcdef" for c in file_id):
+            raise ValueError(f"bad file_id {file_id!r}")
+        return self.root / f"{file_id}.json"
+
+    def save(self, m: Manifest) -> None:
+        _atomic_write(self._path(m.file_id), m.to_json().encode())
+
+    def load(self, file_id: str) -> Manifest | None:
+        try:
+            return Manifest.from_json(self._path(file_id).read_bytes())
+        except FileNotFoundError:
+            return None
+
+    def list(self) -> list[Manifest]:
+        """All known files — backs ``GET /files`` the way the reference's
+        manifest-dir scan does (StorageNode.java:364-393)."""
+        out = []
+        for p in sorted(self.root.glob("*.json")):
+            try:
+                out.append(Manifest.from_json(p.read_bytes()))
+            except (ValueError, KeyError):
+                continue  # skip corrupt manifest rather than failing the listing
+        return out
+
+    def delete(self, file_id: str) -> bool:
+        try:
+            self._path(file_id).unlink()
+            return True
+        except FileNotFoundError:
+            return False
+
+
+class NodeStore:
+    """A node's complete on-disk state: ``<root>/chunks`` + ``<root>/manifests``.
+    Survives restarts, matching the reference's durability claim
+    (README.md:179)."""
+
+    def __init__(self, data_root: Path, node_id: int) -> None:
+        self.root = Path(data_root) / f"node-{node_id}"
+        self.chunks = ChunkStore(self.root / "chunks")
+        self.manifests = ManifestStore(self.root / "manifests")
+
+    def gc(self) -> list[str]:
+        """Delete chunks referenced by no manifest (the reference has no
+        delete/GC at all — SURVEY.md §2.5(5)). Returns deleted digests."""
+        live: set[str] = set()
+        for m in self.manifests.list():
+            live.update(m.digests())
+        dead = [d for d in self.chunks.digests() if d not in live]
+        for d in dead:
+            self.chunks.delete(d)
+        return dead

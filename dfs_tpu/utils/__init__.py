@@ -1,0 +1,2 @@
+from dfs_tpu.utils.hashing import sha256_hex  # noqa: F401
+from dfs_tpu.utils.logging import get_logger  # noqa: F401

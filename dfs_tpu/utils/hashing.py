@@ -1,0 +1,60 @@
+"""CPU hashing helpers.
+
+The reference's entire hash engine is ``sha256Hex(byte[])`` via
+``java.security.MessageDigest`` returning lowercase hex
+(StorageNode.java:603-613). This module is the host-side equivalent; the TPU
+batched implementation lives in ``dfs_tpu.ops.sha256_jax`` and is verified
+bit-exact against this one. When the optional C++ native library is built
+(``dfs_tpu/native``), it accelerates bulk hashing transparently.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+def sha256_hex(data: bytes | bytearray | memoryview | np.ndarray) -> str:
+    """Lowercase-hex SHA-256, the system-wide content address
+    (fileId = sha256(file) — StorageNode.java:127)."""
+    if isinstance(data, np.ndarray):
+        data = data.tobytes()
+    return hashlib.sha256(data).hexdigest()
+
+
+def sha256_many_hex(chunks: list[bytes]) -> list[str]:
+    """Digest a batch of byte strings. Uses the native C++ library when
+    available, else hashlib. Kept as a single entry point so the CPU
+    fragmenters get native acceleration for free."""
+    try:
+        from dfs_tpu.native import native_sha256_many
+
+        out = native_sha256_many(chunks)
+        if out is not None:
+            return out
+    except Exception:  # pragma: no cover - native lib is optional
+        pass
+    return [hashlib.sha256(c).hexdigest() for c in chunks]
+
+
+def gear_table(seed: int = 0x9E3779B9) -> np.ndarray:
+    """Deterministic 256-entry uint32 Gear table via splitmix64.
+
+    Both the CPU oracle and the TPU kernel index this same table, so chunk
+    boundaries are identical across backends by construction.
+    """
+    out = np.empty(256, dtype=np.uint64)
+    x = np.uint64(seed & 0xFFFFFFFFFFFFFFFF)
+    GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+    M1 = np.uint64(0xBF58476D1CE4E5B9)
+    M2 = np.uint64(0x94D049BB133111EB)
+    with np.errstate(over="ignore"):
+        for i in range(256):
+            x = x + GOLDEN
+            z = x
+            z = (z ^ (z >> np.uint64(30))) * M1
+            z = (z ^ (z >> np.uint64(27))) * M2
+            z = z ^ (z >> np.uint64(31))
+            out[i] = z
+    return (out & np.uint64(0xFFFFFFFF)).astype(np.uint32)

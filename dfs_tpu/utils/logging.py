@@ -1,0 +1,44 @@
+"""Structured logging.
+
+The reference logs via ``System.out.printf`` tagged ``[<nodeId>]`` with no
+levels (SURVEY.md §5.5, StorageNode.java:43,125-136). Here every node gets a
+namespaced stdlib logger plus a tiny counter registry for first-class metrics
+(upload/download bytes, replication failures, dedup hits) that the HTTP API
+exposes at ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import defaultdict
+
+
+def get_logger(name: str, node_id: int | None = None) -> logging.Logger:
+    suffix = f".node{node_id}" if node_id is not None else ""
+    logger = logging.getLogger(f"dfs_tpu.{name}{suffix}")
+    if not logging.getLogger("dfs_tpu").handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+        root = logging.getLogger("dfs_tpu")
+        root.addHandler(h)
+        root.setLevel(logging.INFO)
+        root.propagate = False
+    return logger
+
+
+class Counters:
+    """Thread-safe monotonic counters; one instance per node runtime."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._c: dict[str, int] = defaultdict(int)
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._c[name] += by
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._c)

@@ -1,0 +1,61 @@
+"""Test environment: force JAX onto a virtual 8-device CPU mesh.
+
+Real multi-chip TPU hardware is not available in CI; all sharding tests run
+against ``--xla_force_host_platform_device_count=8`` exactly as the driver's
+multi-chip dry-run does. Must run before the first ``import jax`` anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# The heavily-unrolled sha256/gear kernels are slow to compile on the 1-core
+# CI host; cache compiled executables across test runs.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/.cache/jax")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+# The environment's sitecustomize imports jax and registers the axon TPU
+# plugin before this conftest runs, so JAX_PLATFORMS=axon is already latched
+# into jax.config and mutating os.environ alone is not enough. Force the CPU
+# platform and drop the axon factory — its backend init dials a TPU tunnel
+# that can hang every test when busy/stale. Tests are CPU-only by design.
+jax.config.update("jax_platforms", "cpu")
+_xb._backend_factories.pop("axon", None)
+
+# Persistent compile cache (env vars above are latched too late for the same
+# reason — set the config directly). Kernel compiles on this 1-core host take
+# tens of seconds; the cache makes re-runs near-instant.
+jax.config.update("jax_compilation_cache_dir", "/root/.cache/jax")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(1234)
+
+
+@pytest.fixture(scope="session")
+def example_files():
+    """The reference's de-facto fixtures (examples/, SURVEY.md §4) recreated
+    synthetically: small text, html, and binary payloads."""
+    r = np.random.default_rng(7)
+    return {
+        "teste.txt": b"Arquivo de teste para upload.\n",
+        "pag1.html": (b"<html><head><title>p</title></head><body>"
+                      + b"<p>hello world</p>" * 12 + b"</body></html>"),
+        "id.jpg": r.integers(0, 256, size=9506, dtype=np.uint8).tobytes(),
+        "pl.png": r.integers(0, 256, size=2154, dtype=np.uint8).tobytes(),
+        "empty.bin": b"",
+        "tiny.bin": b"ab",
+    }

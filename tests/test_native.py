@@ -1,0 +1,40 @@
+"""Native C++ core vs Python oracles (skipped cleanly if g++ unavailable)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from dfs_tpu.config import CDCParams
+from dfs_tpu.fragmenter.cdc_cpu import CpuCdcFragmenter, cdc_cuts_ref
+from dfs_tpu.native import get_lib, native_gear_cuts, native_sha256_many
+from dfs_tpu.utils.hashing import gear_table
+
+pytestmark = pytest.mark.skipif(get_lib() is None,
+                                reason="native toolchain unavailable")
+
+PARAMS = CDCParams(min_size=64, avg_size=256, max_size=1024)
+
+
+def test_native_sha256_batch(rng):
+    msgs = [rng.integers(0, 256, size=int(n), dtype=np.uint8).tobytes()
+            for n in [0, 1, 55, 56, 64, 65, 1000, 5000]]
+    assert native_sha256_many(msgs) == [
+        hashlib.sha256(m).hexdigest() for m in msgs]
+
+
+def test_native_gear_cuts_match_spec(rng):
+    table = gear_table()
+    for n in [0, 10, 1000, 50_000]:
+        data = rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+        got = native_gear_cuts(data, table, PARAMS.mask,
+                               PARAMS.min_size, PARAMS.max_size)
+        assert got.tolist() == cdc_cuts_ref(data, PARAMS)
+
+
+def test_native_matches_numpy_fragmenter(rng):
+    data = rng.integers(0, 256, size=80_000, dtype=np.uint8).tobytes()
+    frag = CpuCdcFragmenter(PARAMS)
+    got = native_gear_cuts(data, frag.table, PARAMS.mask,
+                           PARAMS.min_size, PARAMS.max_size)
+    assert got.tolist() == frag.cuts(data).tolist()

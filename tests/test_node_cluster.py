@@ -1,0 +1,274 @@
+"""Integration tests: an N-node cluster in one process (each node a real
+asyncio server pair on localhost ports), scripting the reference's manual
+verification scenarios (README.md:172-179, SURVEY.md §4) plus the new
+capabilities (dedup transfer skip, write-quorum, repair, delete).
+
+No TPU involved: nodes use the CPU CDC fragmenter — the fragmenter interface
+makes the distributed layer backend-agnostic.
+"""
+
+import asyncio
+import socket
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dfs_tpu.cli.client import NodeClient
+from dfs_tpu.config import CDCParams, ClusterConfig, NodeConfig, PeerAddr
+from dfs_tpu.node.runtime import (DownloadError, NotFoundError,
+                                  StorageNodeServer, UploadError)
+
+CDC = CDCParams(min_size=64, avg_size=256, max_size=1024)
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def make_cluster_cfg(n: int, rf: int = 2) -> ClusterConfig:
+    ports = _free_ports(2 * n)
+    peers = tuple(
+        PeerAddr(node_id=i + 1, host="127.0.0.1",
+                 port=ports[2 * i], internal_port=ports[2 * i + 1])
+        for i in range(n))
+    return ClusterConfig(peers=peers, replication_factor=rf)
+
+
+async def start_nodes(cluster: ClusterConfig, root: Path,
+                      ids=None, **cfg_kw) -> dict[int, StorageNodeServer]:
+    nodes = {}
+    for p in cluster.peers:
+        if ids is not None and p.node_id not in ids:
+            continue
+        cfg = NodeConfig(node_id=p.node_id, cluster=cluster, data_root=root,
+                         fragmenter="cdc", cdc=CDC, **cfg_kw)
+        node = StorageNodeServer(cfg)
+        await node.start()
+        nodes[p.node_id] = node
+    return nodes
+
+
+async def stop_nodes(nodes) -> None:
+    for n in nodes.values():
+        await n.stop()
+
+
+def test_upload_download_across_nodes(tmp_path, rng):
+    """Round-trip through different nodes: upload at node 1, list + download
+    at node 3 (reference scenario README.md:173-176)."""
+    data = rng.integers(0, 256, size=50_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(5)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            manifest, stats = await nodes[1].upload(data, "blob.bin")
+            assert stats["uniqueChunks"] == manifest.total_chunks
+            # every node lists the file (announce-to-all, §3.4)
+            for n in nodes.values():
+                assert [f["fileId"] for f in n.list_files()] == [manifest.file_id]
+            m2, got = await nodes[3].download(manifest.file_id)
+            assert got == data and m2.name == "blob.bin"
+            # downloading node must have pulled remote chunks
+            assert nodes[3].counters.snapshot().get("chunks_fetched_remote", 0) > 0
+            return manifest
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_download_with_one_node_offline(tmp_path, rng):
+    """The reference's headline fault-tolerance claim, automated: kill one
+    node, download still reconstructs (README.md:177, StorageNode.java:425-441)."""
+    data = rng.integers(0, 256, size=80_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(5)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            manifest, _ = await nodes[1].upload(data, "resilient.bin")
+            # kill node 4 (its chunks stay on its disk, but it's unreachable)
+            await nodes.pop(4).stop()
+            _, got = await nodes[2].download(manifest.file_id)
+            assert got == data
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_upload_with_node_down_write_quorum(tmp_path, rng):
+    """Upload succeeds with a node down (write-quorum) — the reference aborts
+    the whole upload in this case (StorageNode.java:218-221); SURVEY.md §5.3
+    mandates quorum + repair instead. After the node returns, repair_once
+    restores full replication."""
+    data = rng.integers(0, 256, size=60_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(5)
+        nodes = await start_nodes(cluster, tmp_path, ids={1, 2, 3, 4},
+                                  retries=1, connect_timeout_s=0.3)
+        try:
+            manifest, _ = await nodes[1].upload(data, "quorum.bin")
+            _, got = await nodes[2].download(manifest.file_id)
+            assert got == data
+
+            # node 5 comes back empty-handed; repair pushes its chunks
+            nodes.update(await start_nodes(cluster, tmp_path, ids={5},
+                                           retries=1, connect_timeout_s=0.3))
+            repaired = await nodes[1].repair_once()
+            ids = cluster.sorted_ids()
+            from dfs_tpu.node.placement import replica_set
+            for c in manifest.chunks:
+                for target in replica_set(c.digest, ids, 2):
+                    assert nodes[target].store.chunks.has(c.digest), \
+                        f"chunk {c.digest[:8]} missing on node {target}"
+            assert repaired > 0
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_upload_fails_below_quorum(tmp_path, rng):
+    """With every replica target down and quorum unreachable, upload must
+    fail loudly (HTTP 500 'Replication failed' at the API layer)."""
+    data = rng.integers(0, 256, size=30_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path, ids={1},
+                                  retries=1, connect_timeout_s=0.2,
+                                  write_quorum=2)
+        try:
+            with pytest.raises(UploadError):
+                await nodes[1].upload(data, "doomed.bin")
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_dedup_skips_transfer(tmp_path, rng):
+    """Re-uploading identical content must move (almost) no chunk bytes —
+    the content-addressed dedup the reference only has at whole-file level
+    (SURVEY.md §2.5(4))."""
+    data = rng.integers(0, 256, size=100_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(4)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            _, s1 = await nodes[1].upload(data, "v1.bin")
+            assert s1["transferredBytes"] > 0
+            _, s2 = await nodes[1].upload(data, "v1-again.bin")
+            assert s2["transferredBytes"] == 0
+            assert s2["dedupSkippedBytes"] > 0
+
+            # near-duplicate: most chunks shared → transfer ≪ full size
+            edited = data[:500] + b"PATCH" + data[500:]
+            _, s3 = await nodes[2].upload(edited, "v2.bin")
+            assert s3["transferredBytes"] < len(edited) // 2
+            return None
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_http_api_roundtrip(tmp_path, rng):
+    """Full external-surface parity pass over real HTTP: /status /files
+    /upload /download /metrics /manifest + DELETE (reference routes
+    StorageNode.java:71-89)."""
+    data = rng.integers(0, 256, size=20_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path)
+        c1 = NodeClient(port=cluster.peer(1).port)
+        c2 = NodeClient(port=cluster.peer(2).port)
+        try:
+            assert await asyncio.to_thread(c1.status) == "OK"
+            info = await asyncio.to_thread(
+                c1.upload, data, "hello file.bin")  # space → URL-encoding path
+            assert info["fileId"]
+            files = await asyncio.to_thread(c2.list_files)
+            assert [f.name for f in files] == ["hello file.bin"]
+            got = await asyncio.to_thread(c2.download, info["fileId"])
+            assert got == data
+            man = await asyncio.to_thread(c2.manifest, info["fileId"])
+            assert man["fileId"] == info["fileId"]
+            metrics = await asyncio.to_thread(c1.metrics)
+            assert metrics["uploads"] == 1
+            # unknown file → 404 (reference :408-411)
+            try:
+                await asyncio.to_thread(c1.download, "0" * 64)
+                raise AssertionError("expected 404")
+            except RuntimeError as e:
+                assert "404" in str(e)
+            assert "Deleted" == await asyncio.to_thread(c1.delete, info["fileId"])
+            assert await asyncio.to_thread(c1.list_files) == []
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_corrupt_chunk_detected(tmp_path, rng):
+    """Flip bytes in a stored chunk on every replica → download must fail
+    with integrity error, not return corrupt data (whole-file gate is the
+    reference's check at StorageNode.java:453-458; ours also catches it at
+    chunk granularity on remote fetch)."""
+    data = rng.integers(0, 256, size=40_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            manifest, _ = await nodes[1].upload(data, "victim.bin")
+            victim = manifest.chunks[0].digest
+            for n in nodes.values():
+                p = n.store.chunks._path(victim)
+                if p.is_file():
+                    raw = bytearray(p.read_bytes())
+                    raw[0] ^= 0xFF
+                    p.write_bytes(bytes(raw))
+            with pytest.raises((DownloadError, NotFoundError)):
+                await nodes[2].download(manifest.file_id)
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_manifest_fallback_from_peers(tmp_path, rng):
+    """A node that never saw the announce can still serve the download by
+    pulling the manifest from peers (fixes reference silent-loss, §5.3)."""
+    data = rng.integers(0, 256, size=25_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(4)
+        nodes = await start_nodes(cluster, tmp_path, ids={1, 2, 3})
+        try:
+            manifest, _ = await nodes[1].upload(data, "late.bin")
+            # node 4 was down for the announce; bring it up now
+            nodes.update(await start_nodes(cluster, tmp_path, ids={4}))
+            assert nodes[4].store.manifests.load(manifest.file_id) is None
+            _, got = await nodes[4].download(manifest.file_id)
+            assert got == data
+            # and it cached the manifest for next time
+            assert nodes[4].store.manifests.load(manifest.file_id) is not None
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())

@@ -1,0 +1,91 @@
+"""Content-addressed store + manifest v2 + fixed fragmenter unit tests."""
+
+import hashlib
+
+import pytest
+
+from dfs_tpu.fragmenter.fixed import FixedFragmenter
+from dfs_tpu.meta.manifest import ChunkRef, Manifest
+from dfs_tpu.store.cas import ChunkStore, NodeStore
+from dfs_tpu.utils.hashing import sha256_hex
+
+
+def test_fixed_fragmenter_reference_semantics():
+    """Split rule from StorageNode.java:140-155: base = total/parts, first
+    total%parts fragments get +1 byte."""
+    data = bytes(range(23))
+    chunks = FixedFragmenter(parts=5).chunk(data)
+    assert [c.length for c in chunks] == [5, 5, 5, 4, 4]
+    assert [c.offset for c in chunks] == [0, 5, 10, 15, 19]
+    for c in chunks:
+        assert c.digest == hashlib.sha256(
+            data[c.offset:c.offset + c.length]).hexdigest()
+
+
+def test_fixed_fragmenter_tiny_and_empty(example_files):
+    """Zero-byte fragments for tiny files (SURVEY.md §2.5(8))."""
+    chunks = FixedFragmenter(parts=5).chunk(b"ab")
+    assert [c.length for c in chunks] == [1, 1, 0, 0, 0]
+    chunks = FixedFragmenter(parts=5).chunk(b"")
+    assert [c.length for c in chunks] == [0] * 5
+    assert all(c.digest == sha256_hex(b"") for c in chunks)
+
+
+def test_manifest_roundtrip(example_files):
+    data = example_files["id.jpg"]
+    m = FixedFragmenter(parts=5).manifest(data, name="id.jpg")
+    m2 = Manifest.from_json(m.to_json())
+    assert m2 == m
+    assert m2.file_id == sha256_hex(data)
+    assert m2.total_chunks == 5
+
+
+def test_manifest_validates_coverage():
+    with pytest.raises(ValueError):
+        Manifest(file_id="0" * 64, name="x", size=10, fragmenter="fixed",
+                 chunks=(ChunkRef(0, 0, 5, "a" * 64),))
+
+
+def test_chunk_store_put_get_dedup(tmp_path):
+    cs = ChunkStore(tmp_path / "chunks")
+    data = b"hello chunk"
+    d = sha256_hex(data)
+    assert cs.put(d, data) is True
+    assert cs.put(d, data) is False  # dedup hit
+    assert cs.get(d) == data
+    assert cs.has(d)
+    assert cs.get("f" * 64) is None
+    with pytest.raises(ValueError):
+        cs.put("a" * 64, b"mismatched")
+    with pytest.raises(ValueError):
+        cs.get("not-a-digest")
+
+
+def test_node_store_gc(tmp_path, example_files):
+    ns = NodeStore(tmp_path, node_id=1)
+    data = example_files["pag1.html"]
+    m = FixedFragmenter(parts=3).manifest(data, name="pag1.html")
+    for c in m.chunks:
+        ns.chunks.put(c.digest, data[c.offset:c.offset + c.length])
+    ns.manifests.save(m)
+    orphan = sha256_hex(b"orphan")
+    ns.chunks.put(orphan, b"orphan")
+    dead = ns.gc()
+    assert dead == [orphan]
+    assert all(ns.chunks.has(c.digest) for c in m.chunks)
+
+    # restart durability (reference claim README.md:179)
+    ns2 = NodeStore(tmp_path, node_id=1)
+    assert ns2.manifests.load(m.file_id) == m
+    got = b"".join(ns2.chunks.get(c.digest) for c in m.chunks)
+    assert got == data
+
+
+def test_manifest_listing(tmp_path, example_files):
+    ns = NodeStore(tmp_path, node_id=2)
+    names = ["teste.txt", "pag1.html"]
+    for n in names:
+        ns.manifests.save(FixedFragmenter(parts=2).manifest(
+            example_files[n], name=n))
+    listed = {m.name for m in ns.manifests.list()}
+    assert listed == set(names)

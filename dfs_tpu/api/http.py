@@ -61,13 +61,17 @@ def binary(status: int, data: bytes, filename: str) -> bytes:
 
 
 def make_http_handler(node: "StorageNodeServer"):
+    import time
+
     async def handler(reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        t0 = time.perf_counter()
         try:
             out = await _serve_one(node, reader)
         except Exception as e:  # noqa: BLE001
             node.log.warning("http error: %s", e)
             out = plain(500, f"Internal error: {e}")
+        node.latency.record("http.request", time.perf_counter() - t0)
         try:
             writer.write(out)
             await writer.drain()
@@ -124,6 +128,7 @@ async def _serve_one(node: "StorageNodeServer",
         snap = node.counters.snapshot()
         snap["nodeId"] = node.cfg.node_id
         snap["underReplicated"] = len(node.under_replicated)
+        snap["latency"] = node.latency.snapshot()
         return as_json(200, snap)
 
     if method == "GET" and path == "/manifest":

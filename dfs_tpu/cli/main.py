@@ -27,10 +27,18 @@ def _client(args) -> NodeClient:
 def cmd_serve(args) -> int:
     from dfs_tpu.node.runtime import StorageNodeServer
 
-    cluster = ClusterConfig.localhost(
-        n_nodes=args.nodes, base_port=args.base_port,
-        base_internal_port=args.base_internal_port,
-        replication_factor=args.replication_factor)
+    if args.cluster_config:
+        cluster = ClusterConfig.from_file(args.cluster_config)
+        if args.replication_factor is not None:
+            print(f"warning: --replication-factor ignored; using "
+                  f"{cluster.replication_factor} from {args.cluster_config}",
+                  file=sys.stderr)
+    else:
+        cluster = ClusterConfig.localhost(
+            n_nodes=args.nodes, base_port=args.base_port,
+            base_internal_port=args.base_internal_port,
+            replication_factor=args.replication_factor
+            if args.replication_factor is not None else 2)
     cfg = NodeConfig(
         node_id=args.node_id, cluster=cluster,
         data_root=Path(args.data_root), fragmenter=args.fragmenter,
@@ -181,10 +189,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     serve = sub.add_parser("serve", help="run a storage node")
     serve.add_argument("--node-id", type=int, required=True)
+    serve.add_argument("--cluster-config", default=None,
+                       help="JSON/TOML cluster membership file (overrides "
+                            "--nodes/--base-port/--replication-factor)")
     serve.add_argument("--nodes", type=int, default=5)
     serve.add_argument("--base-port", type=int, default=5001)
     serve.add_argument("--base-internal-port", type=int, default=6001)
-    serve.add_argument("--replication-factor", type=int, default=2)
+    serve.add_argument("--replication-factor", type=int, default=None)
     serve.add_argument("--data-root", default="data")
     serve.add_argument("--fragmenter", default="cdc",
                        choices=["fixed", "cdc", "cdc-tpu"])

@@ -102,6 +102,29 @@ class ClusterConfig:
         return sorted(p.node_id for p in self.peers)
 
     @staticmethod
+    def from_file(path: str | Path) -> "ClusterConfig":
+        """Load membership from JSON/TOML — the explicit-cluster-config fix
+        for reference defect §2.5(1). JSON shape::
+
+            {"replication_factor": 2,
+             "peers": [{"node_id": 1, "host": "10.0.0.1",
+                        "port": 5001, "internal_port": 6001}, ...]}
+
+        TOML uses a ``[[peers]]`` array of tables with the same keys.
+        """
+        path = Path(path)
+        text = path.read_text()
+        if path.suffix == ".toml":
+            import tomllib
+
+            d = tomllib.loads(text)
+        else:
+            d = json.loads(text)
+        return ClusterConfig(
+            peers=tuple(PeerAddr(**p) for p in d["peers"]),
+            replication_factor=int(d.get("replication_factor", 2)))
+
+    @staticmethod
     def localhost(n_nodes: int, base_port: int = 5001,
                   base_internal_port: int = 6001,
                   replication_factor: int = 2) -> "ClusterConfig":

@@ -42,6 +42,18 @@ class Fragmenter(abc.ABC):
             chunks=tuple(self.chunk(data)),
         )
 
+    def manifest_stream(self, blocks, name: str, store=None) -> Manifest:
+        """Chunk a block stream. CDC backends override with true
+        bounded-memory streaming (fragmenter/stream.py); this fallback
+        materializes (FixedFragmenter needs the total size upfront — its
+        split rule depends on it, StorageNode.java:140)."""
+        data = b"".join(blocks)
+        m = self.manifest(data, name=name)
+        if store is not None:
+            for c in m.chunks:
+                store(c.digest, data[c.offset:c.offset + c.length])
+        return m
+
 
 def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5) -> Fragmenter:
     """Factory keyed by NodeConfig.fragmenter."""

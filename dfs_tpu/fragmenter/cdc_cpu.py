@@ -60,19 +60,27 @@ def cdc_cuts_ref(data: bytes, params: CDCParams,
     return cuts
 
 
-def gear_bitmap_numpy(data: np.ndarray, table: np.ndarray, mask: int,
-                      prev_g: np.ndarray | None = None) -> np.ndarray:
+def gear_bitmap_carry(data: np.ndarray, table: np.ndarray, mask: int,
+                      prev_g: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """Vectorized windowed Gear bitmap — same math as ops.gear_jax, in NumPy.
-    data: [N] uint8; prev_g: [31] uint32 halo (zeros at stream start)."""
+    data: [N] uint8; prev_g: [31] uint32 halo (zeros at stream start).
+    Returns (bitmap, new halo) — the single source of truth for the CPU
+    kernel; both the one-shot and streaming paths call this."""
     n = data.shape[0]
     g = table[data.astype(np.int32)]
-    if prev_g is None:
-        prev_g = np.zeros(HALO, dtype=np.uint32)
     gp = np.concatenate([prev_g, g])
     h = np.zeros(n, dtype=np.uint32)
     for k in range(WINDOW):
         h += gp[HALO - k: HALO - k + n] << np.uint32(k)
-    return (h & np.uint32(mask)) == 0
+    return (h & np.uint32(mask)) == 0, gp[-HALO:]
+
+
+def gear_bitmap_numpy(data: np.ndarray, table: np.ndarray, mask: int,
+                      prev_g: np.ndarray | None = None) -> np.ndarray:
+    """Bitmap-only convenience wrapper over :func:`gear_bitmap_carry`."""
+    if prev_g is None:
+        prev_g = np.zeros(HALO, dtype=np.uint32)
+    return gear_bitmap_carry(data, table, mask, prev_g)[0]
 
 
 class CpuCdcFragmenter(Fragmenter):
@@ -81,6 +89,17 @@ class CpuCdcFragmenter(Fragmenter):
     def __init__(self, params: CDCParams | None = None) -> None:
         self.params = params or CDCParams()
         self.table = gear_table(self.params.seed)
+
+    def bitmap_tile(self, arr: np.ndarray,
+                    prev_g: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Streaming tile kernel: (bitmap, new 31-entry Gear halo)."""
+        return gear_bitmap_carry(arr, self.table, self.params.mask, prev_g)
+
+    def manifest_stream(self, blocks, name: str, store=None):
+        from dfs_tpu.fragmenter.stream import manifest_from_stream
+
+        return manifest_from_stream(blocks, self.params, self.bitmap_tile,
+                                    name, self.name, store)
 
     def cuts(self, data: bytes | np.ndarray) -> np.ndarray:
         arr = np.frombuffer(data, dtype=np.uint8) if isinstance(

@@ -111,3 +111,29 @@ class TpuCdcFragmenter(Fragmenter):
         digests = self.digest_spans(arr, spans)
         return [ChunkRef(index=i, offset=o, length=ln, digest=dg)
                 for i, ((o, ln), dg) in enumerate(zip(spans, digests))]
+
+    # ---- streaming (bounded memory for unbounded streams, SURVEY.md §5.7) --
+
+    def bitmap_tile(self, arr: np.ndarray,
+                    prev_g) -> tuple[np.ndarray, np.ndarray]:
+        """Device tile kernel adapted to the streaming interface. Full tiles
+        go straight to the compiled kernel; short tiles (any position in the
+        stream) take the NumPy kernel — identical math, and it computes the
+        halo from the *real* bytes, so the result is exact even mid-stream
+        (zero-padding the device tile would poison the halo)."""
+        n = arr.shape[0]
+        if n == self.tile_size:
+            jnp = self._jax.numpy
+            bitmap, tail = self._tile_fn(jnp.asarray(arr), jnp.asarray(prev_g))
+            return np.asarray(bitmap), np.asarray(tail)
+        from dfs_tpu.fragmenter.cdc_cpu import gear_bitmap_carry
+
+        return gear_bitmap_carry(arr, self.table, self.params.mask,
+                                 np.asarray(prev_g, dtype=np.uint32))
+
+    def manifest_stream(self, blocks, name: str, store=None):
+        from dfs_tpu.fragmenter.stream import manifest_from_stream, reblock
+
+        return manifest_from_stream(
+            reblock(blocks, self.tile_size), self.params, self.bitmap_tile,
+            name, self.name, store, hash_batch=self.hash_batch)

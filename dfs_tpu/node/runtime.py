@@ -36,6 +36,7 @@ from dfs_tpu.node.placement import replica_set
 from dfs_tpu.store.cas import NodeStore
 from dfs_tpu.utils.hashing import sha256_hex, sha256_many_hex
 from dfs_tpu.utils.logging import Counters, get_logger
+from dfs_tpu.utils.trace import LatencyRecorder, span
 
 
 class UploadError(RuntimeError):
@@ -60,6 +61,7 @@ class StorageNodeServer:
         self.client = InternalClient(cfg.connect_timeout_s,
                                      cfg.request_timeout_s, cfg.retries)
         self.counters = Counters()
+        self.latency = LatencyRecorder()
         self.log = get_logger("node", cfg.node_id)
         self.under_replicated: set[str] = set()  # digests needing repair
         self._internal_server: asyncio.AbstractServer | None = None
@@ -164,10 +166,13 @@ class StorageNodeServer:
                 if p.node_id != self.cfg.node_id]
 
     async def upload(self, data: bytes, name: str) -> tuple[Manifest, dict]:
-        file_id = sha256_hex(data)
+        with span("upload.hash_file", self.latency):
+            file_id = sha256_hex(data)
         if not name:
             name = f"file-{file_id[:8]}"  # reference default, StorageNode.java:133-135
-        manifest = self.fragmenter.manifest(data, name=name, file_id=file_id)
+        with span("upload.fragment", self.latency):
+            manifest = self.fragmenter.manifest(data, name=name,
+                                                file_id=file_id)
         ids = self.cfg.cluster.sorted_ids()
         rf = self.cfg.cluster.replication_factor
 
@@ -225,8 +230,9 @@ class StorageNodeServer:
                                  node_id, e)
                 self.counters.inc("replication_failures")
 
-        await asyncio.gather(*(replicate(nid, w)
-                               for nid, w in per_node.items()))
+        with span("upload.replicate", self.latency):
+            await asyncio.gather(*(replicate(nid, w)
+                                   for nid, w in per_node.items()))
 
         # Write-quorum policy (vs reference write-all abort, :218-221).
         failed = [d for d, n in copies.items() if n < self.cfg.write_quorum]
@@ -305,7 +311,8 @@ class StorageNodeServer:
             async with sem:
                 return await self._fetch_chunk(c.digest, c.length)
 
-        parts = await asyncio.gather(*(fetch(c) for c in manifest.chunks))
+        with span("download.gather", self.latency):
+            parts = await asyncio.gather(*(fetch(c) for c in manifest.chunks))
         data = b"".join(parts)
         # Whole-file integrity gate, exactly the reference's
         # sha256(assembled) == fileId check (StorageNode.java:453-458).

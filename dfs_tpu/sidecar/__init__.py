@@ -1,0 +1,1 @@
+from dfs_tpu.sidecar.service import SidecarClient, SidecarServer  # noqa: F401

@@ -1,0 +1,108 @@
+"""gRPC sidecar: the accelerator pipeline as a local service (north star,
+BASELINE.json: "The Java StorageNode calls the TPU backend over a local gRPC
+sidecar during upload").
+
+Any host process — a storage node written in another language, or a Python
+node that wants the TPU in a separate process so device init/compile never
+blocks the serving loop — streams bytes in and gets chunk boundaries +
+per-chunk SHA-256 digests back.
+
+The wire contract uses gRPC *generic* handlers with identity (bytes)
+serialization: the environment ships grpcio but not grpc_tools/protoc-gen-py,
+and the payloads are length-delimited binary anyway (protobuf would Base64
+nothing, buy nothing). Methods (all under service ``dfs.Sidecar``):
+
+- ``ChunkHash``  unary-unary. Request: raw file bytes. Response: JSON header
+  (chunk table: offset/length/digest + params echo) — the exact information
+  the node runtime needs to build a Manifest.
+- ``Health``     unary-unary. Request: empty. Response: JSON status.
+
+The sidecar accepts a ``fragmenter`` name at startup ("cdc" CPU NumPy or
+"cdc-tpu" JAX/TPU) — the node runtime's plugin choice, reference §2.3 analog.
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+
+import grpc
+
+_SERVICE = "dfs.Sidecar"
+
+
+def _identity(x: bytes) -> bytes:
+    return x
+
+
+class SidecarServer:
+    def __init__(self, port: int = 0, fragmenter: str = "cdc",
+                 cdc_params=None, max_workers: int = 4) -> None:
+        from dfs_tpu.fragmenter.base import get_fragmenter
+
+        self.fragmenter = get_fragmenter(fragmenter, cdc_params=cdc_params)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[("grpc.max_receive_message_length", 1 << 30),
+                     ("grpc.max_send_message_length", 1 << 30)])
+        self._server.add_generic_rpc_handlers((self._handlers(),))
+        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+
+    def _handlers(self) -> grpc.GenericRpcHandler:
+        def chunk_hash(request: bytes, ctx) -> bytes:
+            chunks = self.fragmenter.chunk(request)
+            return json.dumps({
+                "fragmenter": self.fragmenter.name,
+                "size": len(request),
+                "chunks": [{"index": c.index, "offset": c.offset,
+                            "length": c.length, "digest": c.digest}
+                           for c in chunks],
+            }).encode()
+
+        def health(request: bytes, ctx) -> bytes:
+            return json.dumps({"ok": True,
+                               "fragmenter": self.fragmenter.name}).encode()
+
+        methods = {
+            f"/{_SERVICE}/ChunkHash": grpc.unary_unary_rpc_method_handler(
+                chunk_hash, request_deserializer=_identity,
+                response_serializer=_identity),
+            f"/{_SERVICE}/Health": grpc.unary_unary_rpc_method_handler(
+                health, request_deserializer=_identity,
+                response_serializer=_identity),
+        }
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, call_details):
+                return methods.get(call_details.method)
+
+        return Handler()
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._server.stop(grace)
+
+
+class SidecarClient:
+    def __init__(self, port: int, host: str = "127.0.0.1") -> None:
+        self._channel = grpc.insecure_channel(
+            f"{host}:{port}",
+            options=[("grpc.max_receive_message_length", 1 << 30),
+                     ("grpc.max_send_message_length", 1 << 30)])
+        self._chunk_hash = self._channel.unary_unary(
+            f"/{_SERVICE}/ChunkHash", request_serializer=_identity,
+            response_deserializer=_identity)
+        self._health = self._channel.unary_unary(
+            f"/{_SERVICE}/Health", request_serializer=_identity,
+            response_deserializer=_identity)
+
+    def chunk_hash(self, data: bytes) -> dict:
+        return json.loads(self._chunk_hash(data))
+
+    def health(self) -> dict:
+        return json.loads(self._health(b""))
+
+    def close(self) -> None:
+        self._channel.close()

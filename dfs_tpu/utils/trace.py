@@ -1,0 +1,109 @@
+"""Tracing / profiling (SURVEY.md §5.1 — absent in the reference, which has
+only printf logging).
+
+Two layers:
+- :class:`LatencyRecorder` — lock-protected streaming histograms (log2
+  buckets) for request/phase latencies; snapshots expose count/p50/p90/p99/max
+  per name, served by the node's ``/metrics`` endpoint.
+- :func:`span` — context manager that records into a recorder and, when a
+  ``jax.profiler`` trace session is active (``start_trace``), also emits a
+  ``TraceAnnotation`` so device timelines in TensorBoard/XProf line up with
+  framework phases. The jax import is deferred and optional.
+"""
+
+from __future__ import annotations
+
+import bisect
+import contextlib
+import math
+import threading
+import time
+
+# bucket upper bounds in seconds: 1us .. ~134s, powers of two
+_BOUNDS = [2.0 ** e for e in range(-20, 8)]
+
+
+class LatencyRecorder:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._hist: dict[str, list[int]] = {}
+        self._stats: dict[str, tuple[int, float, float]] = {}  # n, sum, max
+
+    def record(self, name: str, seconds: float) -> None:
+        idx = bisect.bisect_left(_BOUNDS, seconds)
+        with self._lock:
+            h = self._hist.setdefault(name, [0] * (len(_BOUNDS) + 1))
+            h[min(idx, len(_BOUNDS))] += 1
+            n, s, mx = self._stats.get(name, (0, 0.0, 0.0))
+            self._stats[name] = (n + 1, s + seconds, max(mx, seconds))
+
+    def _quantile(self, h: list[int], q: float) -> float:
+        total = sum(h)
+        if total == 0:
+            return 0.0
+        target = math.ceil(q * total)
+        seen = 0
+        for i, c in enumerate(h):
+            seen += c
+            if seen >= target:
+                return _BOUNDS[min(i, len(_BOUNDS) - 1)]
+        return _BOUNDS[-1]
+
+    def snapshot(self) -> dict[str, dict[str, float]]:
+        with self._lock:
+            out = {}
+            for name, h in self._hist.items():
+                n, s, mx = self._stats[name]
+                out[name] = {
+                    "count": n,
+                    "mean_s": round(s / n, 6) if n else 0.0,
+                    "p50_s": round(self._quantile(h, 0.50), 6),
+                    "p90_s": round(self._quantile(h, 0.90), 6),
+                    "p99_s": round(self._quantile(h, 0.99), 6),
+                    "max_s": round(mx, 6),
+                }
+            return out
+
+
+# Set only while device_trace() is active. span() consults this flag instead
+# of importing jax per call: importing jax inside a request span would block
+# the node's event loop for seconds (and on jax-less hosts a failed import is
+# retried every call — failed imports aren't cached in sys.modules).
+_PROFILING = False
+
+
+@contextlib.contextmanager
+def span(name: str, recorder: LatencyRecorder | None = None):
+    """Time a phase; annotate the device trace when one is being captured."""
+    ann = None
+    if _PROFILING:
+        import jax.profiler  # device_trace already imported it
+
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if recorder is not None:
+            recorder.record(name, dt)
+        if ann is not None:
+            with contextlib.suppress(Exception):
+                ann.__exit__(None, None, None)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """Capture a jax.profiler device trace around a block (TensorBoard/XProf
+    readable). Usage: ``with device_trace('/tmp/trace'): frag.chunk(data)``."""
+    global _PROFILING
+    import jax.profiler
+
+    jax.profiler.start_trace(log_dir)
+    _PROFILING = True
+    try:
+        yield
+    finally:
+        _PROFILING = False
+        jax.profiler.stop_trace()

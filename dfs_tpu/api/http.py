@@ -129,6 +129,7 @@ async def _serve_one(node: "StorageNodeServer",
         snap["nodeId"] = node.cfg.node_id
         snap["underReplicated"] = len(node.under_replicated)
         snap["latency"] = node.latency.snapshot()
+        snap["peersAlive"] = node.health.snapshot()
         return as_json(200, snap)
 
     if method == "GET" and path == "/manifest":

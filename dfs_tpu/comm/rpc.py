@@ -28,7 +28,17 @@ from dfs_tpu.config import PeerAddr
 
 
 class RpcError(RuntimeError):
-    pass
+    """Base for storage-plane call failures."""
+
+
+class RpcUnreachable(RpcError):
+    """Transport-level failure: connect/read timed out for every attempt.
+    The only error class that should count as evidence a peer is *dead*."""
+
+
+class RpcRemoteError(RpcError):
+    """The peer was reachable and answered with an application-level error
+    (e.g. chunk not found). Says nothing about peer liveness."""
 
 
 class InternalClient:
@@ -55,24 +65,29 @@ class InternalClient:
             except (ConnectionError, OSError):
                 pass
         if not resp.get("ok", False):
-            raise RpcError(f"peer {peer.node_id} error: {resp.get('error')}")
+            raise RpcRemoteError(
+                f"peer {peer.node_id} error: {resp.get('error')}")
         return resp, rbody
 
     async def call(self, peer: PeerAddr, header: dict,
-                   body: bytes = b"") -> tuple[dict, bytes]:
-        """Bounded-retry call (reference: 3 attempts, StorageNode.java:208)."""
+                   body: bytes = b"",
+                   retries: int | None = None) -> tuple[dict, bytes]:
+        """Bounded-retry call (reference: 3 attempts, StorageNode.java:208).
+        ``retries`` overrides the default — the node runtime passes 1 for
+        peers its health monitor believes are dead (fast-fail probe)."""
+        attempts = retries if retries is not None else self.retries
         last: Exception | None = None
-        for attempt in range(self.retries):
+        for attempt in range(attempts):
             try:
                 return await self._call_once(peer, header, body)
             except RpcError:
                 raise  # application-level error: retrying won't help
             except (OSError, asyncio.TimeoutError, RuntimeError) as e:
                 last = e
-                if attempt + 1 < self.retries:
+                if attempt + 1 < attempts:
                     await asyncio.sleep(0.05 * (attempt + 1))
-        raise RpcError(
-            f"peer {peer.node_id} unreachable after {self.retries} attempts: {last}")
+        raise RpcUnreachable(
+            f"peer {peer.node_id} unreachable after {attempts} attempts: {last}")
 
     # ---- typed ops ----
 

@@ -151,6 +151,8 @@ class NodeConfig:
     connect_timeout_s: float = 2.0  # reference: 2000 ms, StorageNode.java:229-230
     request_timeout_s: float = 10.0
     retries: int = 3               # reference: 3 attempts, StorageNode.java:208,320
+    health_probe_s: float = 5.0    # peer health probe interval; 0 = data-path
+                                   # feedback only (no background loop)
     # Write policy: the reference aborts the whole upload if ANY peer is down
     # (StorageNode.java:218-221) — write-all. We default to quorum=1 remote
     # copy with background repair (SURVEY.md §5.3 build note).

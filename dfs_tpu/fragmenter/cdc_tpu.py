@@ -3,16 +3,18 @@
 Upload-side hot path of the reference — whole-file hash + per-fragment
 split/hash (StorageNode.java:127,154-171) — re-designed for TPU:
 
-1. **Gear bitmap on device.** The stream is processed in fixed-size tiles
-   (static shapes for XLA); each tile call computes the boundary-candidate
-   bitmap with 32 shifted uint32 adds (ops.gear_jax). The 31-byte halo is
-   threaded between tiles. Tiles are dispatched asynchronously so host→HBM
-   transfer of tile k+1 overlaps compute of tile k.
-2. **Cut selection on host** (ops.boundary) — metadata-sized.
-3. **Batched SHA-256 on device.** Selected chunks are packed into
-   power-of-two *buckets* by padded block count (a 10 KiB chunk doesn't pay
-   for a 64 KiB chunk's padding) with batch rounded up, so XLA compiles a
-   handful of shapes once and reuses them forever.
+1. **One host→HBM transfer.** The stream is padded to a tile multiple and
+   device_put once; every later stage reads the resident array (host↔device
+   traffic is the usual ceiling — SURVEY.md §7.4(4)).
+2. **Gear bitmap on device.** Fixed-size tiles are dynamic-sliced out of the
+   resident array; each computes the boundary-candidate bitmap with 32
+   shifted uint32 adds (ops.gear_jax), threading the 31-byte halo.
+3. **Cut selection on host** (ops.boundary) — metadata-sized.
+4. **Device-side packing + batched SHA-256.** For each power-of-two
+   block-count bucket, chunk bytes are *gathered on device* from the resident
+   array (starts/lens are the only uploads), FIPS padding (0x80 + bit length)
+   is applied arithmetically, bytes are packed big-endian into uint32 words,
+   and the batch is hashed in lockstep — no per-chunk host copies anywhere.
 
 Byte-identical chunking vs the CPU oracle is guaranteed by construction
 (shared selection + windowed==rolling hash identity) and enforced by tests.
@@ -27,7 +29,8 @@ from dfs_tpu.fragmenter.base import Fragmenter
 from dfs_tpu.meta.manifest import ChunkRef
 from dfs_tpu.ops.boundary import cuts_to_spans, select_cuts
 from dfs_tpu.ops.gear_jax import HALO, make_gear_tile_fn
-from dfs_tpu.ops.sha256_jax import pad_messages, sha256_blocks, state_to_hex
+from dfs_tpu.ops.pack_jax import digest_gathered, make_resident_tile_fn
+from dfs_tpu.ops.sha256_jax import state_to_hex
 from dfs_tpu.utils.hashing import gear_table
 
 _DEFAULT_TILE = 32 * 1024 * 1024  # 32 MiB per device dispatch
@@ -48,41 +51,65 @@ class TpuCdcFragmenter(Fragmenter):
         self.params = params or CDCParams()
         self.table = gear_table(self.params.seed)
         self.tile_size = int(tile_size)
+        if self.tile_size & (self.tile_size - 1):
+            raise ValueError("tile_size must be a power of two (keeps the "
+                             "resident-array shape bucketing a tile multiple)")
         self.hash_batch = int(hash_batch)
+        # Device offsets are int32 (TPU runs with x64 disabled): streams at or
+        # beyond this take the streaming path, which carries no absolute
+        # device offsets and is unbounded.
+        self._max_resident = 2**31 - self.tile_size
         self._jax = jax
+        # streaming path: per-tile transfer; chunk() path: resident array
         self._tile_fn = make_gear_tile_fn(self.table, self.params.mask,
                                           self.tile_size)
+        self._resident_tile_fn = make_resident_tile_fn(
+            self.table, self.params.mask, self.tile_size)
 
-    # ---- stage 1+2: device bitmap, host selection ----
-
-    def cuts(self, data: bytes | np.ndarray) -> np.ndarray:
-        jnp = self._jax.numpy
-        arr = np.frombuffer(data, dtype=np.uint8) if isinstance(
-            data, (bytes, bytearray, memoryview)) else data
+    def _device_put_padded(self, arr: np.ndarray):
+        """One host→HBM transfer of the stream, padded to the next
+        power-of-two tile multiple: the jit cache then holds at most
+        ~log2(max file size) resident shapes instead of one per
+        file-size-in-tiles (bytes are cheap; XLA compiles are not)."""
         n = arr.shape[0]
-        if n == 0:
-            return np.zeros((0,), dtype=np.int64)
+        m = _next_pow2(max(self.tile_size, n))
+        if m != n:
+            padded = np.zeros((m,), dtype=np.uint8)
+            padded[:n] = arr
+            arr = padded
+        return self._jax.device_put(arr)
 
+    # ---- stage 2+3: device bitmap over the resident array, host selection --
+
+    def _cuts_resident(self, dev, n: int) -> np.ndarray:
+        jnp = self._jax.numpy
         prev_g = jnp.zeros((HALO,), jnp.uint32)
-        futures = []
+        pieces = []
         for off in range(0, n, self.tile_size):
-            tile = arr[off: off + self.tile_size]
-            if tile.shape[0] < self.tile_size:  # pad final tile (static shape)
-                padded = np.zeros((self.tile_size,), dtype=np.uint8)
-                padded[: tile.shape[0]] = tile
-                tile = padded
-            bitmap, prev_g = self._tile_fn(jnp.asarray(tile), prev_g)
-            futures.append((off, min(self.tile_size, n - off), bitmap))
-
-        pieces = [np.asarray(bm)[:length] for _, length, bm in futures]
-        bitmap_all = np.concatenate(pieces) if len(pieces) > 1 else pieces[0]
+            bitmap, prev_g = self._resident_tile_fn(
+                dev, jnp.int32(off), prev_g)
+            pieces.append(bitmap)
+        bitmap_all = np.concatenate([np.asarray(b) for b in pieces])[:n]
         return select_cuts(bitmap_all, n, self.params.min_size,
                            self.params.max_size)
 
-    # ---- stage 3: bucketed batched hashing on device ----
+    def cuts(self, data: bytes | np.ndarray) -> np.ndarray:
+        arr = np.frombuffer(data, dtype=np.uint8) if isinstance(
+            data, (bytes, bytearray, memoryview)) else data
+        if arr.shape[0] == 0:
+            return np.zeros((0,), dtype=np.int64)
+        return self._cuts_resident(self._device_put_padded(arr), arr.shape[0])
 
-    def digest_spans(self, arr: np.ndarray,
-                     spans: list[tuple[int, int]]) -> list[str]:
+    # ---- stage 4: device-side packing + bucketed batched hashing ----
+
+    def _bucket_rows(self, nb: int) -> int:
+        """Rows per device call, scaled so every bucket works on a roughly
+        constant word volume (hash_batch rows at the 64-block bucket)."""
+        return max(16, min(self.hash_batch,
+                           _next_pow2(self.hash_batch * 64 // nb)))
+
+    def digest_spans_resident(self, dev,
+                              spans: list[tuple[int, int]]) -> list[str]:
         jnp = self._jax.numpy
         digests: list[str | None] = [None] * len(spans)
         by_blocks: dict[int, list[int]] = {}
@@ -91,24 +118,31 @@ class TpuCdcFragmenter(Fragmenter):
             by_blocks.setdefault(nb, []).append(i)
 
         for nb, idxs in sorted(by_blocks.items()):
-            for lo in range(0, len(idxs), self.hash_batch):
-                group = idxs[lo: lo + self.hash_batch]
-                # batch always padded to hash_batch: exactly one compiled
-                # shape per block-bucket (padded rows have nblocks=0 and cost
-                # one masked scan; they're dropped on the host).
-                msgs = [arr[spans[i][0]: spans[i][0] + spans[i][1]]
-                        for i in group]
-                words, counts = pad_messages(msgs, n_blocks=nb,
-                                             batch=self.hash_batch)
-                state = sha256_blocks(jnp.asarray(words), jnp.asarray(counts))
+            rows = self._bucket_rows(nb)
+            for lo in range(0, len(idxs), rows):
+                group = idxs[lo: lo + rows]
+                starts = np.zeros((rows,), dtype=np.int32)
+                lens = np.full((rows,), -1, dtype=np.int32)  # -1: padding row
+                for j, i in enumerate(group):
+                    starts[j], lens[j] = spans[i]
+                state = digest_gathered(dev, jnp.asarray(starts),
+                                        jnp.asarray(lens), l64=nb * 64)
                 for i, dg in zip(group, state_to_hex(np.asarray(state))):
                     digests[i] = dg
         return digests  # type: ignore[return-value]
 
     def chunk(self, data: bytes) -> list[ChunkRef]:
         arr = np.frombuffer(data, dtype=np.uint8)
-        spans = cuts_to_spans(self.cuts(arr))
-        digests = self.digest_spans(arr, spans)
+        n = arr.shape[0]
+        if n == 0:
+            return []
+        if n >= self._max_resident:
+            # beyond the int32 device-offset range: stream instead
+            m = self.manifest_stream([arr], name="")
+            return list(m.chunks)
+        dev = self._device_put_padded(arr)
+        spans = cuts_to_spans(self._cuts_resident(dev, n))
+        digests = self.digest_spans_resident(dev, spans)
         return [ChunkRef(index=i, offset=o, length=ln, digest=dg)
                 for i, ((o, ln), dg) in enumerate(zip(spans, digests))]
 
@@ -131,9 +165,34 @@ class TpuCdcFragmenter(Fragmenter):
         return gear_bitmap_carry(arr, self.table, self.params.mask,
                                  np.asarray(prev_g, dtype=np.uint32))
 
+    def digest_many(self, payloads: list[bytes]) -> list[str]:
+        """Batch-hash host byte strings on device (pow2 length buckets, one
+        compiled shape per bucket). Used by the streaming path, where chunk
+        payloads are host-resident by construction."""
+        from dfs_tpu.ops.sha256_jax import (pad_messages, sha256_blocks,
+                                            state_to_hex)
+
+        jnp = self._jax.numpy
+        out: list[str | None] = [None] * len(payloads)
+        by_blocks: dict[int, list[int]] = {}
+        for i, p in enumerate(payloads):
+            by_blocks.setdefault(
+                _next_pow2((len(p) + 8) // 64 + 1), []).append(i)
+        for nb, idxs in sorted(by_blocks.items()):
+            rows = self._bucket_rows(nb)
+            for lo in range(0, len(idxs), rows):
+                group = idxs[lo: lo + rows]
+                words, counts = pad_messages(
+                    [payloads[i] for i in group], n_blocks=nb, batch=rows)
+                state = sha256_blocks(jnp.asarray(words), jnp.asarray(counts))
+                for i, dg in zip(group, state_to_hex(np.asarray(state))):
+                    out[i] = dg
+        return out  # type: ignore[return-value]
+
     def manifest_stream(self, blocks, name: str, store=None):
         from dfs_tpu.fragmenter.stream import manifest_from_stream, reblock
 
         return manifest_from_stream(
             reblock(blocks, self.tile_size), self.params, self.bitmap_tile,
-            name, self.name, store, hash_batch=self.hash_batch)
+            name, self.name, store, hash_batch=self.hash_batch,
+            hash_fn=self.digest_many)

@@ -113,10 +113,14 @@ def manifest_from_stream(blocks: Iterable[bytes], params: CDCParams,
                          bitmap_fn: BitmapFn, name: str,
                          fragmenter_name: str,
                          store: Callable[[str, bytes], None] | None = None,
-                         hash_batch: int = 256) -> Manifest:
+                         hash_batch: int = 256,
+                         hash_fn: Callable[[list[bytes]], list[str]]
+                         = sha256_many_hex) -> Manifest:
     """One-pass streaming upload core: file_id (whole-stream sha256), chunk
     spans, per-chunk digests — optionally persisting each chunk via ``store``
-    — without ever materializing the whole stream."""
+    — without ever materializing the whole stream. ``hash_fn`` digests each
+    finalized batch (CPU native by default; the TPU fragmenter passes its
+    device batch hasher)."""
     chunker = StreamChunker(params, bitmap_fn)
     whole = hashlib.sha256()
     refs: list[ChunkRef] = []
@@ -124,7 +128,7 @@ def manifest_from_stream(blocks: Iterable[bytes], params: CDCParams,
     size = 0
 
     def flush() -> None:
-        digests = sha256_many_hex([b for _, b in pending])
+        digests = hash_fn([b for _, b in pending])
         for (off, payload), dg in zip(pending, digests):
             refs.append(ChunkRef(index=len(refs), offset=off,
                                  length=len(payload), digest=dg))

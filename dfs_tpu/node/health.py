@@ -1,0 +1,75 @@
+"""Failure detection: health-checked peer registry (SURVEY.md §5.3).
+
+The reference's failure detection is a manual ``GET /status`` from the client
+menu (StorageNode.java:71-74) — nodes themselves never probe each other and
+discover death only by timing out mid-request (2 s × 3 attempts per call,
+:208-216). This monitor keeps a live/dead view per peer so the data path can
+skip known-dead peers immediately (one cheap set lookup instead of burning
+the full retry envelope on every chunk), while a low-rate probe loop notices
+recovery and flips peers back to alive.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from dfs_tpu.comm.rpc import InternalClient, RpcUnreachable
+from dfs_tpu.config import ClusterConfig
+
+
+class HealthMonitor:
+    def __init__(self, cluster: ClusterConfig, self_id: int,
+                 client: InternalClient,
+                 probe_interval_s: float = 5.0) -> None:
+        self.cluster = cluster
+        self.self_id = self_id
+        self.client = client
+        self.probe_interval_s = probe_interval_s
+        # optimistic start: everyone alive (matches reference behavior of
+        # always trying peers); flips on first failure
+        self._alive: dict[int, bool] = {
+            p.node_id: True for p in cluster.peers if p.node_id != self_id}
+        self._last_change: dict[int, float] = {}
+        self._task: asyncio.Task | None = None
+
+    def is_alive(self, node_id: int) -> bool:
+        return self._alive.get(node_id, True)
+
+    def mark_dead(self, node_id: int) -> None:
+        """Data-path feedback: a call to this peer just exhausted retries."""
+        if self._alive.get(node_id):
+            self._alive[node_id] = False
+            self._last_change[node_id] = time.monotonic()
+
+    def mark_alive(self, node_id: int) -> None:
+        if not self._alive.get(node_id, True):
+            self._alive[node_id] = True
+            self._last_change[node_id] = time.monotonic()
+
+    def snapshot(self) -> dict[str, bool]:
+        return {str(k): v for k, v in sorted(self._alive.items())}
+
+    async def probe_once(self) -> None:
+        async def probe(peer) -> None:
+            try:
+                await self.client.health(peer)
+                self.mark_alive(peer.node_id)
+            except RpcUnreachable:
+                self.mark_dead(peer.node_id)
+
+        await asyncio.gather(*(probe(p) for p in self.cluster.peers
+                               if p.node_id != self.self_id))
+
+    def start(self) -> None:
+        async def loop() -> None:
+            while True:
+                await asyncio.sleep(self.probe_interval_s)
+                await self.probe_once()
+
+        self._task = asyncio.create_task(loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None

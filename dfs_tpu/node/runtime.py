@@ -27,11 +27,12 @@ from __future__ import annotations
 import asyncio
 from typing import Iterable
 
-from dfs_tpu.comm.rpc import InternalClient, RpcError
+from dfs_tpu.comm.rpc import InternalClient, RpcError, RpcUnreachable
 from dfs_tpu.comm.wire import WireError, read_msg, send_msg, unpack_chunks
 from dfs_tpu.config import NodeConfig
 from dfs_tpu.fragmenter.base import get_fragmenter
 from dfs_tpu.meta.manifest import Manifest
+from dfs_tpu.node.health import HealthMonitor
 from dfs_tpu.node.placement import replica_set
 from dfs_tpu.store.cas import NodeStore
 from dfs_tpu.utils.hashing import sha256_hex, sha256_many_hex
@@ -60,6 +61,8 @@ class StorageNodeServer:
             cfg.fragmenter, cdc_params=cfg.cdc, fixed_parts=cfg.fixed_parts)
         self.client = InternalClient(cfg.connect_timeout_s,
                                      cfg.request_timeout_s, cfg.retries)
+        self.health = HealthMonitor(cfg.cluster, cfg.node_id, self.client,
+                                    probe_interval_s=cfg.health_probe_s)
         self.counters = Counters()
         self.latency = LatencyRecorder()
         self.log = get_logger("node", cfg.node_id)
@@ -79,10 +82,13 @@ class StorageNodeServer:
             self._handle_internal, addr.host, addr.internal_port)
         self._http_server = await asyncio.start_server(
             make_http_handler(self), addr.host, addr.port)
+        if self.cfg.health_probe_s > 0:
+            self.health.start()
         self.log.info("node %d up: http=%d internal=%d",
                       self.cfg.node_id, addr.port, addr.internal_port)
 
     async def stop(self) -> None:
+        self.health.stop()
         for srv in (self._internal_server, self._http_server):
             if srv is not None:
                 srv.close()
@@ -205,9 +211,13 @@ class StorageNodeServer:
                             wanted: list[tuple[str, bytes]]) -> None:
             peer = self.cfg.cluster.peer(node_id)
             digests = [d for d, _ in wanted]
+            # Known-dead peers get one fast probe instead of the full retry
+            # envelope (health registry, SURVEY.md §5.3).
+            retries = None if self.health.is_alive(node_id) else 1
             try:
                 resp, _ = await self.client.call(
-                    peer, {"op": "has_chunks", "digests": digests})
+                    peer, {"op": "has_chunks", "digests": digests},
+                    retries=retries)
                 have = set(resp.get("have", []))
                 missing = [(d, b) for d, b in wanted if d not in have]
                 for d, b in wanted:
@@ -225,10 +235,15 @@ class StorageNodeServer:
                     stats["transferredBytes"] += sum(len(b) for _, b in missing)
                 for d in digests:
                     copies[d] += 1
+                self.health.mark_alive(node_id)
             except RpcError as e:
                 self.log.warning("replication to node %d failed: %s",
                                  node_id, e)
                 self.counters.inc("replication_failures")
+                if isinstance(e, RpcUnreachable):
+                    # only transport-level exhaustion is liveness evidence;
+                    # an application error came from a live peer
+                    self.health.mark_dead(node_id)
 
         with span("upload.replicate", self.latency):
             await asyncio.gather(*(replicate(nid, w)
@@ -271,14 +286,20 @@ class StorageNodeServer:
             return data
         ids = self.cfg.cluster.sorted_ids()
         rf = self.cfg.cluster.replication_factor
-        for target in replica_set(digest, ids, rf):
-            if target == self.cfg.node_id:
-                continue
+        candidates = [t for t in replica_set(digest, ids, rf)
+                      if t != self.cfg.node_id]
+        # try believed-alive replicas first; dead ones remain as last resort
+        candidates.sort(key=lambda t: not self.health.is_alive(t))
+        for target in candidates:
             try:
                 data = await self.client.get_chunk(
                     self.cfg.cluster.peer(target), digest)
-            except RpcError:
+                self.health.mark_alive(target)
+            except RpcUnreachable:
+                self.health.mark_dead(target)
                 continue
+            except RpcError:
+                continue  # live peer without the chunk — not a death signal
             # Verify against the manifest digest before trusting a peer
             # (stronger than the reference, which only checks the whole file).
             if len(data) == length and sha256_hex(data) == digest:

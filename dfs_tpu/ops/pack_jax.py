@@ -1,0 +1,67 @@
+"""Device-side chunk packing + hashing from a resident byte array.
+
+The naive pipeline copies every selected chunk into a padded host buffer
+(a Python loop of ~10^5 numpy slice copies per GiB) before uploading it —
+that host memcpy becomes the bottleneck long before the TPU does. Here the
+file bytes are already resident in HBM (one device_put), and for each length
+bucket the kernel:
+
+1. gathers each chunk's bytes with a [B, l64] index matrix (starts + iota),
+2. applies FIPS-180-4 padding arithmetically (0x80 where pos == len, zeros
+   after, big-endian bit length in the block dictated by the length),
+3. packs bytes big-endian into uint32 words,
+4. runs the batched SHA-256 scan (ops.sha256_jax) with per-row block counts.
+
+Host→device traffic per bucket: two [B] int32 vectors. Everything else stays
+in HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("l64",))
+def digest_gathered(data: jax.Array, starts: jax.Array, lens: jax.Array,
+                    l64: int) -> jax.Array:
+    """data: [M] uint8 (resident); starts/lens: [B] int32 (lens == -1 marks
+    batch-padding rows — their output is garbage and dropped by the caller);
+    l64: padded row length in bytes, static, a multiple of 64 with
+    l64 >= max(lens) + 9. Returns [B, 8] uint32 digest states."""
+    pos = jnp.arange(l64, dtype=jnp.int32)[None, :]
+    idx = jnp.minimum(starts[:, None] + pos, data.shape[0] - 1)
+    raw = jnp.take(data, idx).astype(jnp.uint32)
+    valid = pos < lens[:, None]
+    pad80 = pos == lens[:, None]
+    byte = jnp.where(valid, raw, jnp.uint32(0)) \
+        | jnp.where(pad80, jnp.uint32(0x80), jnp.uint32(0))
+    b = byte.reshape(byte.shape[0], l64 // 4, 4)
+    w = (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
+    nb = (lens + 8) // 64 + 1
+    bitlen = lens.astype(jnp.uint32) * jnp.uint32(8)
+    widx = jnp.arange(l64 // 4, dtype=jnp.int32)[None, :]
+    words = jnp.where(widx == nb[:, None] * 16 - 1, bitlen[:, None], w)
+
+    from dfs_tpu.ops.sha256_jax import _sha256_blocks_impl
+
+    return _sha256_blocks_impl(words.reshape(words.shape[0], -1, 16), nb)
+
+
+def make_resident_tile_fn(table, mask: int, tile: int):
+    """Gear bitmap over a dynamic slice of a resident array: one compile per
+    resident length, no per-tile host→device transfer (unlike
+    ops.gear_jax.make_gear_tile_fn, which ships each tile)."""
+    from dfs_tpu.ops.gear_jax import gear_bitmap_tile
+
+    table_j = jnp.asarray(table, dtype=jnp.uint32)
+    mask_j = jnp.uint32(mask)
+
+    @jax.jit
+    def fn(data: jax.Array, offset: jax.Array, prev_g: jax.Array):
+        t = jax.lax.dynamic_slice(data, (offset,), (tile,))
+        return gear_bitmap_tile(t, prev_g, table_j, mask_j)
+
+    return fn

@@ -1,0 +1,119 @@
+"""Batched SHA-256 as a Pallas TPU kernel.
+
+The XLA version (ops.sha256_jax) leaves scheduling to the compiler; this
+kernel pins the layout to the hardware (pallas_guide.md):
+
+- the message batch rides the VPU's native (8, 128) geometry: each grid step
+  owns 1024 messages, and every working variable (a..h, the 64-entry message
+  schedule) is an (8, 128) uint32 register tile — pure elementwise VPU ops,
+  zero cross-lane traffic;
+- message words are pre-transposed on the host to [L*16, B/128, 128] so the
+  kernel's per-round word fetch ``w_ref[l*16 + t]`` is one contiguous (8,128)
+  VMEM read — no strided gathers;
+- the multi-block scan is a ``fori_loop`` whose body unrolls the 48 schedule
+  steps + 64 rounds (compile-once, run-L-times), with per-message masking so
+  a 1-block message coasts through a 32-block bucket.
+
+Numerical contract: bit-identical to hashlib / ops.sha256_jax — enforced by
+tests in interpret mode on CPU and (on hardware) by the fragmenter's oracle
+tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dfs_tpu.ops.sha256_jax import _H0, _K
+
+BATCH_TILE = 1024  # messages per grid step: (8 sublanes, 128 lanes)
+
+
+def _rotr(x, n: int):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _kernel(nblocks_ref, words_ref, out_ref, *, n_blocks: int):
+    """words_ref: [L*16, 8, 128] u32; nblocks_ref: [8, 128] i32;
+    out_ref: [8, 8, 128] u32 (leading dim = state word index)."""
+    state = [jnp.full((8, 128), jnp.uint32(_H0[i])) for i in range(8)]
+    nb = nblocks_ref[...]
+
+    def block_body(l, state):
+        state = list(state)
+        w = [words_ref[l * 16 + t] for t in range(16)]
+        for t in range(16, 64):
+            s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) \
+                ^ (w[t - 15] >> np.uint32(3))
+            s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) \
+                ^ (w[t - 2] >> np.uint32(10))
+            w.append(w[t - 16] + s0 + w[t - 7] + s1)
+        a, b, c, d, e, f, g, h = state
+        for t in range(64):
+            s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+            ch = (e & f) ^ (~e & g)
+            t1 = h + s1 + ch + _K[t] + w[t]
+            s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+            maj = (a & b) ^ (a & c) ^ (b & c)
+            h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + s0 + maj
+        keep = l < nb
+        new = [a, b, c, d, e, f, g, h]
+        return tuple(jnp.where(keep, s + v, s)
+                     for s, v in zip(state, new))
+
+    state = jax.lax.fori_loop(0, n_blocks, block_body, tuple(state))
+    for i in range(8):
+        out_ref[i] = state[i]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _run(words_t: jax.Array, nblocks2: jax.Array,
+         interpret: bool = False) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    l16, rows, _ = words_t.shape
+    n_blocks = l16 // 16
+    grid = rows // 8
+
+    return pl.pallas_call(
+        functools.partial(_kernel, n_blocks=n_blocks),
+        out_shape=jax.ShapeDtypeStruct((8, rows, 128), jnp.uint32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((8, 128), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((l16, 8, 128), lambda i: (0, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, 8, 128), lambda i: (0, i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(nblocks2, words_t)
+
+
+def sha256_blocks_pallas(words: np.ndarray, nblocks: np.ndarray,
+                         interpret: bool = False) -> np.ndarray:
+    """Drop-in for ops.sha256_jax.sha256_blocks with the Pallas kernel.
+
+    words: [B, L, 16] uint32 (host, from pad_messages); nblocks: [B] int32.
+    Returns [B, 8] uint32. B is padded to BATCH_TILE internally.
+    """
+    bsz, nblk, _ = words.shape
+    rows = -(-bsz // BATCH_TILE) * BATCH_TILE // 128
+    padded = np.zeros((rows * 128, nblk, 16), dtype=np.uint32)
+    padded[:bsz] = words
+    counts = np.zeros((rows * 128,), dtype=np.int32)
+    counts[:bsz] = nblocks
+    # [B, L, 16] -> [L*16, B/128, 128]: per-(l,t) word plane is one VMEM tile
+    words_t = np.ascontiguousarray(
+        padded.reshape(rows, 128, nblk * 16).transpose(2, 0, 1))
+    nblocks2 = counts.reshape(rows, 128)
+
+    out = np.asarray(_run(jnp.asarray(words_t), jnp.asarray(nblocks2),
+                          interpret=interpret))
+    # [8, rows, 128] -> [B, 8]
+    return out.reshape(8, rows * 128).T[:bsz].copy()

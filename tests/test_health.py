@@ -1,0 +1,58 @@
+"""Health-checked peer registry: data-path feedback + probe recovery, and a
+concurrent-upload race check (SURVEY.md §5.2/§5.3)."""
+
+import asyncio
+
+import numpy as np
+
+from tests.test_node_cluster import make_cluster_cfg, start_nodes, stop_nodes
+
+
+def test_health_feedback_and_probe_recovery(tmp_path, rng):
+    data = rng.integers(0, 256, size=30_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path, retries=1,
+                                  connect_timeout_s=0.3)
+        try:
+            # kill node 3; an upload marks it dead via data-path feedback
+            dead = nodes.pop(3)
+            await dead.stop()
+            await nodes[1].upload(data, "a.bin")
+            assert nodes[1].health.is_alive(3) is False
+            assert nodes[1].health.is_alive(2) is True
+
+            # node 3 returns; an explicit probe flips it back
+            nodes.update(await start_nodes(cluster, tmp_path, ids={3},
+                                           retries=1, connect_timeout_s=0.3))
+            await nodes[1].health.probe_once()
+            assert nodes[1].health.is_alive(3) is True
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_concurrent_same_file_uploads(tmp_path, rng):
+    """Two simultaneous uploads of identical bytes: content-addressed
+    idempotent writes make the race benign (the reference's accidental
+    safety, SURVEY.md §5.2 — here it's by construction, with atomic
+    rename-into-place)."""
+    data = rng.integers(0, 256, size=50_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            (m1, _), (m2, _) = await asyncio.gather(
+                nodes[1].upload(data, "same.bin"),
+                nodes[2].upload(data, "same.bin"))
+            assert m1.file_id == m2.file_id
+            assert m1.chunks == m2.chunks
+            _, got = await nodes[3].download(m1.file_id)
+            assert got == data
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())

@@ -1,0 +1,47 @@
+"""Checkpoint/resume (SURVEY.md §5.4): an interrupted upload resumes nearly
+for free — chunks already in the content-addressed store skip transfer, and a
+half-uploaded file is invisible until its manifest lands (manifest-last write
+ordering), exactly the upgrade path SURVEY.md prescribes over the reference's
+partial-fragment-dirs-forever behavior."""
+
+import asyncio
+
+import numpy as np
+
+from tests.test_node_cluster import make_cluster_cfg, start_nodes, stop_nodes
+
+
+def test_interrupted_upload_resumes(tmp_path, rng):
+    data = rng.integers(0, 256, size=120_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            # Simulate an interrupted upload: chunks stored cluster-wide but
+            # the manifest write never happened (crash before manifest-last).
+            frag = nodes[1].fragmenter
+            manifest = frag.manifest(data, name="resume.bin")
+            half = manifest.chunks[: len(manifest.chunks) // 2]
+            for c in half:
+                for n in nodes.values():
+                    n.store.chunks.put(c.digest,
+                                       data[c.offset:c.offset + c.length])
+
+            # invisible: no manifest anywhere → 404 semantics
+            assert nodes[2].store.manifests.load(manifest.file_id) is None
+            assert all(f == [] for f in
+                       (n.list_files() for n in nodes.values()))
+
+            # resume = plain re-upload; only the missing half transfers
+            _, stats = await nodes[1].upload(data, "resume.bin")
+            half_bytes = sum(c.length for c in half)
+            assert stats["transferredBytes"] < len(data) - half_bytes // 2
+            assert stats["dedupSkippedBytes"] > 0
+
+            _, got = await nodes[3].download(manifest.file_id)
+            assert got == data
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())

@@ -72,6 +72,18 @@ def test_fixed_manifest_stream_fallback(rng):
     assert m == frag.manifest(data, "f.bin")
 
 
+def test_chunk_falls_back_to_streaming_beyond_offset_range(rng):
+    """Streams past the int32 device-offset ceiling must route through the
+    streaming path (offset-free) and still match the CPU oracle. The ceiling
+    is shrunk here to keep the test small."""
+    tpu = TpuCdcFragmenter(PARAMS, tile_size=4_096, hash_batch=16)
+    tpu._max_resident = 20_000
+    data = rng.integers(0, 256, size=50_000, dtype=np.uint8).tobytes()
+    got = tpu.chunk(data)
+    want = CpuCdcFragmenter(PARAMS).chunk(data)
+    assert got == want
+
+
 def test_reblock_exact_tiles(rng):
     data = rng.integers(0, 256, size=10_000, dtype=np.uint8).tobytes()
     tiles = list(reblock(_blocks(data, [999]), 4096))

@@ -1,0 +1,161 @@
+"""Strip-scan SHA-256: hash every chunk of a stream in one Pallas pass.
+
+The batched-message kernel (ops.sha256_pallas) needs each message gathered
+into its own row — and arbitrary-offset gathers measured ~0.6 s per 32 MiB
+on v5e, two orders slower than the hash itself. This kernel removes the
+gather: the stream stays in its strip-transposed resident layout
+(ops.cdc_v2.host_to_strips) and *chunk chaining follows the stream order*.
+
+Lane ``s`` walks its strip's 64-byte blocks sequentially (the grid axis);
+at every step it compresses the next block into its running state, writes
+the post-block state out, and — where the selection pass flagged a cut —
+resets to H0 for the next chunk. One grid step therefore advances *all*
+strips by one block: the VPU sees (S/128 · 8, 128) uint32 tiles of pure
+elementwise work, and the only HBM traffic is the linear stream read plus
+the state stream write. Chunk digests are the states at cut positions
+(gathered afterwards — #cuts rows, metadata-sized) plus one batched
+"pad-block" compression applied by ``pad_finalize_device`` (every non-final
+chunk is a whole number of blocks, so its FIPS padding block is synthetic:
+0x80, zeros, bit length).
+
+Layouts (S = strips, padded to a multiple of 128; bps = strip_blocks):
+  words_t  [bps*16, S] u32   block t's word w of strip s at [t*16+w, s]
+  cutflag  [bps, S]    i32   1 after the last block of a chunk
+  states   [bps*8, S]  u32   post-block state word i at [t*8+i, s]
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dfs_tpu.ops.sha256_jax import _H0, _K
+
+FLAG_TILE = 8  # cutflag rows DMA'd per fetch (reused across 8 grid steps)
+
+
+def _rotr(x, n: int):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _compress(state8: list, w: list) -> list:
+    """One SHA-256 compression on vector registers; state8/w: lists of
+    identically-shaped uint32 arrays (any shape — elementwise)."""
+    for t in range(16, 64):
+        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
+        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint32(10))
+        w.append(w[t - 16] + s0 + w[t - 7] + s1)
+    a, b, c, d, e, f, g, h = state8
+    for t in range(64):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + np.uint32(_K[t]) + w[t]
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + s0 + maj
+    return [s + v for s, v in zip(state8, [a, b, c, d, e, f, g, h])]
+
+
+def _strip_kernel(words_ref, flags_ref, out_ref, state_ref):
+    """words_ref: [16, R, 128]; flags_ref: [FLAG_TILE, R, 128];
+    out_ref: [8, R, 128]; state_ref (scratch, persists across the
+    sequential grid): [8, R, 128]. Lanes = strips, organized (R, 128)."""
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        for i in range(8):
+            state_ref[i] = jnp.full_like(state_ref[i], jnp.uint32(_H0[i]))
+
+    state = [state_ref[i] for i in range(8)]
+    w = [words_ref[i] for i in range(16)]
+    new = _compress(state, w)
+    cut = flags_ref[t % FLAG_TILE] != 0
+    for i in range(8):
+        out_ref[i] = new[i]
+        state_ref[i] = jnp.where(cut, jnp.uint32(_H0[i]), new[i])
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def strip_states(words_t: jax.Array, cutflag: jax.Array,
+                 interpret: bool = False) -> jax.Array:
+    """Run the strip scan: (words_t [bps*16, S] u32, cutflag [bps, S] i32)
+    -> states [bps*8, S] u32 (post-block chain state per block)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows, s = words_t.shape
+    bps = rows // 16
+    r = s // 128
+    w3 = words_t.reshape(bps * 16, r, 128)
+    f3 = cutflag.astype(jnp.int32).reshape(bps, r, 128)
+    out = pl.pallas_call(
+        _strip_kernel,
+        out_shape=jax.ShapeDtypeStruct((bps * 8, r, 128), jnp.uint32),
+        grid=(bps,),
+        in_specs=[
+            pl.BlockSpec((16, r, 128), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((FLAG_TILE, r, 128), lambda t: (t // FLAG_TILE, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, r, 128), lambda t: (t, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((8, r, 128), jnp.uint32)],
+        interpret=interpret,
+    )(w3, f3)
+    return out.reshape(bps * 8, s)
+
+
+def strip_states_xla(words_t: jax.Array, cutflag: jax.Array) -> jax.Array:
+    """Pure-XLA fallback with identical semantics (used on CPU where the
+    unrolled Pallas body is slow to interpret, and as a correctness
+    cross-check on TPU)."""
+    rows, s = words_t.shape
+    bps = rows // 16
+    words = words_t.reshape(bps, 16, s)
+    h0 = jnp.broadcast_to(jnp.asarray(_H0)[:, None], (8, s))
+
+    def body(state, xs):
+        block, cut = xs
+        new = _compress([state[i] for i in range(8)],
+                        [block[i] for i in range(16)])
+        new = jnp.stack(new)
+        out = new
+        state = jnp.where((cut != 0)[None, :], h0, new)
+        return state, out
+
+    _, states = jax.lax.scan(body, h0, (words, cutflag))
+    return states.reshape(bps * 8, s)  # [bps, 8, S] -> same row layout
+
+
+def pad_finalize_device(states: jax.Array, lens: jax.Array) -> jax.Array:
+    """Apply the synthetic FIPS padding block to gathered chunk states.
+
+    states: [C, 8] u32 — chain state after each chunk's last content block;
+    lens: [C] i32 — chunk byte length (multiple of 64). Returns [C, 8]
+    final digests. Rows with lens == 0 are padding; output garbage.
+    """
+    zero = jnp.zeros_like(lens, dtype=jnp.uint32)
+    w = [jnp.full_like(zero, jnp.uint32(0x80000000))] + [zero] * 13
+    bits = lens.astype(jnp.uint32) * jnp.uint32(8)
+    w.append(lens.astype(jnp.uint32) >> jnp.uint32(29))   # high bit-length
+    w.append(bits)                                         # low bit-length
+    out = _compress([states[:, i] for i in range(8)], w)
+    return jnp.stack(out, axis=1)
+
+
+def gather_cut_states(states: jax.Array, flat_cuts: jax.Array,
+                      s: int) -> jax.Array:
+    """states: [bps*8, S]; flat_cuts: [C] i32 = t*S + s (or -1 padding) ->
+    [C, 8] chain states (metadata-sized gather)."""
+    t = jnp.maximum(flat_cuts, 0) // s
+    lane = jnp.maximum(flat_cuts, 0) % s
+    idx = (t[:, None] * 8 + jnp.arange(8, dtype=jnp.int32)[None, :]) * s \
+        + lane[:, None]
+    return jnp.take(states.reshape(-1), idx)

@@ -43,6 +43,13 @@ def _resp(status: int, body: bytes, content_type: str,
     return ("\r\n".join(head) + "\r\n\r\n").encode() + body
 
 
+def _bad_id(file_id: str) -> bool:
+    """Malformed fileId -> 400 up front, so a ValueError later in the
+    pipeline (e.g. a corrupt peer manifest) still surfaces as a 500."""
+    return len(file_id) != 64 or any(
+        c not in "0123456789abcdef" for c in file_id)
+
+
 def plain(status: int, text: str) -> bytes:
     return _resp(status, text.encode(), "text/plain; charset=utf-8")
 
@@ -136,6 +143,8 @@ async def _serve_one(node: "StorageNodeServer",
         file_id = query.get("fileId")
         if not file_id:
             return plain(400, "Missing fileId")
+        if _bad_id(file_id):
+            return plain(400, "Bad fileId")
         m = node.store.manifests.load(file_id)
         if m is None:
             return plain(404, "File not found")
@@ -160,6 +169,8 @@ async def _serve_one(node: "StorageNodeServer",
         file_id = query.get("fileId")
         if not file_id:
             return plain(400, "Missing fileId")
+        if _bad_id(file_id):
+            return plain(400, "Bad fileId")
         try:
             manifest, data = await node.download(file_id)
         except NotFoundError:
@@ -179,6 +190,8 @@ async def _serve_one(node: "StorageNodeServer",
         file_id = query.get("fileId")
         if not file_id:
             return plain(400, "Missing fileId")
+        if _bad_id(file_id):
+            return plain(400, "Bad fileId")
         found = await node.delete(file_id)
         return plain(200 if found else 404,
                      "Deleted" if found else "File not found")

@@ -197,8 +197,9 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--base-internal-port", type=int, default=6001)
     serve.add_argument("--replication-factor", type=int, default=None)
     serve.add_argument("--data-root", default="data")
-    serve.add_argument("--fragmenter", default="cdc",
-                       choices=["fixed", "cdc", "cdc-tpu"])
+    serve.add_argument(
+        "--fragmenter", default="cdc",
+        choices=["fixed", "cdc", "cdc-tpu", "cdc-aligned", "cdc-aligned-tpu"])
     serve.add_argument("--min-chunk", type=int, default=2048)
     serve.add_argument("--avg-chunk", type=int, default=8192)
     serve.add_argument("--max-chunk", type=int, default=65536)

@@ -145,7 +145,8 @@ class NodeConfig:
     node_id: int
     cluster: ClusterConfig
     data_root: Path
-    fragmenter: str = "cdc"        # "fixed" | "cdc" | "cdc-tpu"
+    fragmenter: str = "cdc"        # "fixed" | "cdc" | "cdc-tpu" |
+                                   # "cdc-aligned" | "cdc-aligned-tpu"
     cdc: CDCParams = dataclasses.field(default_factory=CDCParams)
     fixed_parts: int = 5           # FixedFragmenter part count (reference: TOTAL_NODES=5)
     connect_timeout_s: float = 2.0  # reference: 2000 ms, StorageNode.java:229-230

@@ -62,9 +62,37 @@ def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5) -> Fragm
     from dfs_tpu.fragmenter.cdc_tpu import TpuCdcFragmenter
     from dfs_tpu.fragmenter.fixed import FixedFragmenter
 
-    params = cdc_params or CDCParams()
     if kind == "fixed":
         return FixedFragmenter(parts=fixed_parts)
+    if kind in ("cdc-aligned", "cdc-aligned-tpu"):
+        from dfs_tpu.fragmenter.cdc_aligned import (AlignedCpuFragmenter,
+                                                    AlignedTpuFragmenter)
+        from dfs_tpu.ops.cdc_v2 import AlignedCdcParams
+
+        if isinstance(cdc_params, AlignedCdcParams):
+            params = cdc_params
+        elif cdc_params is not None:
+            # CDCParams byte sizes -> 64-byte block units (quantized); grow
+            # the strip to fit large --max-chunk values (strips must hold at
+            # least one max-size chunk, and stay 128-block-aligned for the
+            # device compaction tiling).
+            max_blocks = max(1, cdc_params.max_size // 64)
+            default_strip = AlignedCdcParams.__dataclass_fields__[
+                "strip_blocks"].default
+            strip_blocks = default_strip
+            while strip_blocks < max_blocks:
+                strip_blocks *= 2
+            params = AlignedCdcParams(
+                min_blocks=max(1, cdc_params.min_size // 64),
+                avg_blocks=max(1, cdc_params.avg_size // 64),
+                max_blocks=max_blocks,
+                strip_blocks=strip_blocks)
+        else:
+            params = AlignedCdcParams()
+        cls = AlignedCpuFragmenter if kind == "cdc-aligned" \
+            else AlignedTpuFragmenter
+        return cls(params)
+    params = cdc_params or CDCParams()
     if kind == "cdc":
         return CpuCdcFragmenter(params)
     if kind == "cdc-tpu":

@@ -43,7 +43,10 @@ def _rotr(x, n: int):
 
 def _compress(state8: list, w: list) -> list:
     """One SHA-256 compression on vector registers; state8/w: lists of
-    identically-shaped uint32 arrays (any shape — elementwise)."""
+    identically-shaped uint32 arrays (any shape — elementwise). Fully
+    unrolled: the TPU/Pallas form (see ops.sha256_jax for why XLA:CPU must
+    never evaluate this — its shared-DAG evaluation explodes past ~16
+    rounds)."""
     for t in range(16, 64):
         s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
         s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint32(10))
@@ -57,6 +60,48 @@ def _compress(state8: list, w: list) -> list:
         maj = (a & b) ^ (a & c) ^ (b & c)
         h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + s0 + maj
     return [s + v for s, v in zip(state8, [a, b, c, d, e, f, g, h])]
+
+
+def _compress_looped(state8: list, w16: list) -> list:
+    """CPU-safe compression (fori_loop schedule + rounds, small carried
+    state), same list-of-arrays interface as :func:`_compress`."""
+    w0 = jnp.stack(list(w16[:16])
+                   + [jnp.zeros_like(w16[0])] * 48)    # [64, ...]
+    k_arr = jnp.asarray(_K)
+
+    def sched_body(t, w):
+        wm15 = jax.lax.dynamic_index_in_dim(w, t - 15, 0, keepdims=False)
+        wm2 = jax.lax.dynamic_index_in_dim(w, t - 2, 0, keepdims=False)
+        wm7 = jax.lax.dynamic_index_in_dim(w, t - 7, 0, keepdims=False)
+        wm16 = jax.lax.dynamic_index_in_dim(w, t - 16, 0, keepdims=False)
+        s0 = _rotr(wm15, 7) ^ _rotr(wm15, 18) ^ (wm15 >> np.uint32(3))
+        s1 = _rotr(wm2, 17) ^ _rotr(wm2, 19) ^ (wm2 >> np.uint32(10))
+        return jax.lax.dynamic_update_index_in_dim(
+            w, wm16 + s0 + wm7 + s1, t, 0)
+
+    w = jax.lax.fori_loop(16, 64, sched_body, w0)
+
+    def round_body(t, carry):
+        a, b, c, d, e, f, g, h = carry
+        wt = jax.lax.dynamic_index_in_dim(w, t, 0, keepdims=False)
+        kt = jax.lax.dynamic_index_in_dim(k_arr, t, 0, keepdims=False)
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + kt + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        return (t1 + s0 + maj, a, b, c, d + t1, e, f, g)
+
+    out = jax.lax.fori_loop(0, 64, round_body, tuple(state8))
+    return [s + v for s, v in zip(state8, out)]
+
+
+def _compress_dispatch(state8: list, w: list) -> list:
+    """Unrolled on accelerators, looped on CPU (same rule and rationale as
+    ops.sha256_jax._compress_block)."""
+    if jax.default_backend() == "cpu":
+        return _compress_looped(state8, w)
+    return _compress(state8, w)
 
 
 def _strip_kernel(words_ref, flags_ref, out_ref, state_ref):
@@ -123,8 +168,8 @@ def strip_states_xla(words_t: jax.Array, cutflag: jax.Array) -> jax.Array:
 
     def body(state, xs):
         block, cut = xs
-        new = _compress([state[i] for i in range(8)],
-                        [block[i] for i in range(16)])
+        new = _compress_dispatch([state[i] for i in range(8)],
+                                 [block[i] for i in range(16)])
         new = jnp.stack(new)
         out = new
         state = jnp.where((cut != 0)[None, :], h0, new)
@@ -146,7 +191,7 @@ def pad_finalize_device(states: jax.Array, lens: jax.Array) -> jax.Array:
     bits = lens.astype(jnp.uint32) * jnp.uint32(8)
     w.append(lens.astype(jnp.uint32) >> jnp.uint32(29))   # high bit-length
     w.append(bits)                                         # low bit-length
-    out = _compress([states[:, i] for i in range(8)], w)
+    out = _compress_dispatch([states[:, i] for i in range(8)], w)
     return jnp.stack(out, axis=1)
 
 

@@ -1,0 +1,129 @@
+"""Fused aligned-CDC pipeline + fragmenters vs the NumPy oracle.
+
+Mirrors the reference's only self-checks (replication hash echo
+StorageNode.java:248-257, download hash-vs-id :453-458) as properties:
+device spans/digests == oracle == hashlib, streaming == one-shot, and the
+manifest machinery round-trips.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from dfs_tpu.fragmenter.base import get_fragmenter
+from dfs_tpu.fragmenter.cdc_aligned import (AlignedCpuFragmenter,
+                                            AlignedTpuFragmenter)
+from dfs_tpu.ops.cdc_pipeline import cut_capacity, segment_chunks
+from dfs_tpu.ops.cdc_v2 import (AlignedCdcParams, chunk_file_np,
+                                file_id_from_digests)
+
+SMALL = AlignedCdcParams(min_blocks=2, avg_blocks=4, max_blocks=16,
+                         strip_blocks=64)  # 4 KiB strips for fast tests
+
+
+def corpus(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, size=n, dtype=np.uint8)
+
+
+@pytest.mark.parametrize("n", [1, 63, 64, 65, 4096, 4097, 40000, 300001])
+def test_segment_matches_oracle(n):
+    data = corpus(n, seed=n)
+    got = segment_chunks(data, SMALL, lane_multiple=8)
+    want = chunk_file_np(data, SMALL)
+    assert got == want
+
+
+def test_segment_digests_are_sha256():
+    data = corpus(50000, seed=2)
+    for o, ln, dg in segment_chunks(data, SMALL, lane_multiple=8):
+        assert dg == hashlib.sha256(data[o:o + ln].tobytes()).hexdigest()
+
+
+def test_segment_low_entropy_and_sparse_candidates():
+    # all-zeros: no candidates -> max-size chunks everywhere (forced cuts)
+    data = np.zeros((100000,), dtype=np.uint8)
+    got = segment_chunks(data, SMALL, lane_multiple=8)
+    assert got == chunk_file_np(data, SMALL)
+    for _, ln, _ in got[:-1]:
+        assert ln <= SMALL.max_blocks * 64
+
+
+def test_cut_capacity_bounds_real_cut_count():
+    data = corpus(300000, seed=5)
+    got = segment_chunks(data, SMALL, lane_multiple=8)
+    s = -(-data.shape[0] // SMALL.strip_len)
+    assert len(got) <= cut_capacity(s, SMALL)
+
+
+# ------------------------------------------------------------ fragmenters --
+
+def tpu_frag(**kw):
+    return AlignedTpuFragmenter(SMALL, cpu_cutoff=0, lane_multiple=8, **kw)
+
+
+def test_fragmenters_agree_and_cover():
+    data = corpus(200000, seed=7).tobytes()
+    cpu = AlignedCpuFragmenter(SMALL).chunk(data)
+    tpu = tpu_frag().chunk(data)
+    assert cpu == tpu
+    assert sum(c.length for c in cpu) == len(data)
+
+
+def test_segment_loop_is_transparent():
+    # seg_strips=2 forces the multi-segment path; strips restart chunking,
+    # so segment boundaries must not change the result
+    data = corpus(SMALL.strip_len * 5 + 321, seed=8).tobytes()
+    assert tpu_frag(seg_strips=2).chunk(data) == tpu_frag().chunk(data)
+
+
+def test_manifest_and_stream_match():
+    data = corpus(150000, seed=9).tobytes()
+    frag = tpu_frag(seg_strips=2)
+    m1 = frag.manifest(data, name="f")
+    stored: dict[str, bytes] = {}
+    blocks = [data[i:i + 7000] for i in range(0, len(data), 7000)]
+    m2 = frag.manifest_stream(blocks, name="f",
+                              store=lambda dg, b: stored.setdefault(dg, b))
+    assert m1.file_id == m2.file_id == file_id_from_digests(m1.digests())
+    assert m1.chunks == m2.chunks
+    # stored payloads reassemble the stream byte-identically
+    assert b"".join(stored[c.digest] for c in m2.chunks) == data
+    for dg, b in stored.items():
+        assert hashlib.sha256(b).hexdigest() == dg
+
+
+def test_empty_and_tiny():
+    assert tpu_frag().chunk(b"") == []
+    m = tpu_frag().manifest(b"x", name="t")
+    assert m.size == 1 and len(m.chunks) == 1
+    assert m.chunks[0].digest == hashlib.sha256(b"x").hexdigest()
+
+
+def test_factory_kinds():
+    assert get_fragmenter("cdc-aligned").name == "cdc-aligned"
+    assert get_fragmenter("cdc-aligned-tpu").name == "cdc-aligned-tpu"
+
+
+def test_factory_byte_params_conversion():
+    from dfs_tpu.config import CDCParams
+
+    f = get_fragmenter("cdc-aligned", cdc_params=CDCParams(
+        min_size=1024, avg_size=4096, max_size=32768))
+    assert (f.params.min_blocks, f.params.avg_blocks,
+            f.params.max_blocks) == (16, 64, 512)
+    # --max-chunk beyond the default strip grows the strip (CLI values that
+    # are legal for cdc/cdc-tpu must not crash node startup)
+    big = get_fragmenter("cdc-aligned", cdc_params=CDCParams(
+        min_size=2048, avg_size=8192, max_size=256 * 1024))
+    assert big.params.max_blocks == 4096
+    assert big.params.strip_blocks >= big.params.max_blocks
+
+
+def test_streaming_honors_seg_strips():
+    frag = tpu_frag(seg_strips=2)
+    data = corpus(SMALL.strip_len * 5, seed=11).tobytes()
+    segs = list(frag._segments([data]))
+    assert [s.shape[0] for s in segs] == [SMALL.strip_len * 2,
+                                          SMALL.strip_len * 2,
+                                          SMALL.strip_len]

@@ -49,26 +49,36 @@ def main() -> int:
     n_versions = int(sys.argv[2]) if len(sys.argv) > 2 else 6
 
     from dfs_tpu.config import CDCParams
+    from dfs_tpu.fragmenter.cdc_aligned import AlignedCpuFragmenter
     from dfs_tpu.fragmenter.cdc_cpu import CpuCdcFragmenter
 
-    frag = CpuCdcFragmenter(CDCParams())
-    logical = 0
-    stored: dict[str, int] = {}
-    for i, v in enumerate(synth_versions(size, n_versions)):
-        chunks = frag.chunk(v.tobytes())
-        logical += v.size
-        new = 0
-        for c in chunks:
-            if c.digest not in stored:
-                stored[c.digest] = c.length
-                new += c.length
-        print(f"version {i}: {v.size / 2**20:.1f} MiB, "
-              f"new bytes {new / 2**20:.2f} MiB", file=sys.stderr)
+    versions = synth_versions(size, n_versions)
 
-    physical = sum(stored.values())
-    ratio = logical / physical
+    def ratio_for(frag) -> float:
+        logical = 0
+        stored: dict[str, int] = {}
+        for i, v in enumerate(versions):
+            chunks = frag.chunk(v.tobytes())
+            logical += v.size
+            new = 0
+            for c in chunks:
+                if c.digest not in stored:
+                    stored[c.digest] = c.length
+                    new += c.length
+            print(f"[{frag.name}] version {i}: {v.size / 2**20:.1f} MiB, "
+                  f"new bytes {new / 2**20:.2f} MiB", file=sys.stderr)
+        return logical / sum(stored.values())
+
+    # headline: the flagship aligned fragmenter (what the TPU path stores);
+    # the byte-granular rolling CDC goes to stderr as the upper bound the
+    # block quantization trades against.
+    ratio = ratio_for(AlignedCpuFragmenter())
+    rolling = ratio_for(CpuCdcFragmenter(CDCParams()))
+    print(f"aligned dedup {ratio:.3f}x vs rolling {rolling:.3f}x "
+          f"({100 * ratio / rolling:.1f}% of byte-granular)",
+          file=sys.stderr)
     print(json.dumps({
-        "metric": "dedup_ratio_versioned_corpus",
+        "metric": "dedup_ratio_versioned_corpus_aligned",
         "value": round(ratio, 3),
         "unit": "logical/physical",
         "vs_baseline": round(ratio / 1.0, 3),  # fixed-N reference dedups ~1.0x

@@ -46,8 +46,9 @@ def _resp(status: int, body: bytes, content_type: str,
 def _bad_id(file_id: str) -> bool:
     """Malformed fileId -> 400 up front, so a ValueError later in the
     pipeline (e.g. a corrupt peer manifest) still surfaces as a 500."""
-    return len(file_id) != 64 or any(
-        c not in "0123456789abcdef" for c in file_id)
+    from dfs_tpu.utils.hashing import is_hex_digest
+
+    return not is_hex_digest(file_id)
 
 
 def plain(status: int, text: str) -> bytes:

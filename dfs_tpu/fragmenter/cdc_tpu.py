@@ -36,8 +36,7 @@ from dfs_tpu.utils.hashing import gear_table
 _DEFAULT_TILE = 32 * 1024 * 1024  # 32 MiB per device dispatch
 
 
-def _next_pow2(x: int) -> int:
-    return 1 << (max(1, x) - 1).bit_length()
+from dfs_tpu.utils.hashing import next_pow2 as _next_pow2  # noqa: E402
 
 
 class TpuCdcFragmenter(Fragmenter):

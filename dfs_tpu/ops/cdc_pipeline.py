@@ -34,6 +34,7 @@ import numpy as np
 
 from dfs_tpu.ops.cdc_v2 import (AlignedCdcParams, gear_candidates_device,
                                 select_cuts_device)
+from dfs_tpu.utils.hashing import next_pow2
 
 BLOCK = 64
 
@@ -153,10 +154,6 @@ def digests_to_hex(dig: np.ndarray) -> list[str]:
     return [hx[i * 64:(i + 1) * 64] for i in range(dig.shape[0])]
 
 
-def _next_pow2(x: int) -> int:
-    return 1 << (max(1, x) - 1).bit_length()
-
-
 def segment_chunks(data: np.ndarray, params: AlignedCdcParams,
                    lane_multiple: int = 128) -> list[tuple[int, int, str]]:
     """Chunk one segment (``data`` [n] u8, n <= segment capacity) on device
@@ -178,8 +175,11 @@ def segment_chunks(data: np.ndarray, params: AlignedCdcParams,
         return []
     sl = params.strip_len
     bps = params.strip_blocks
-    s_real = -(-n // sl)
-    s_pad = max(lane_multiple, _next_pow2(s_real))
+    # transfer size is bucketed to the next power-of-two strip count so the
+    # jit cache holds ~log2(seg_strips) shapes instead of one per distinct
+    # tail size (zero-pad copy is cheap; XLA compiles are not)
+    s_real = next_pow2(-(-n // sl))
+    s_pad = max(lane_multiple, s_real)
 
     if n != s_real * sl:
         raw = np.zeros((s_real * sl,), dtype=np.uint8)

@@ -90,3 +90,58 @@ def shard_inputs(mesh: Mesh, data: np.ndarray, words: np.ndarray,
         jax.device_put(words, NamedSharding(mesh, P(("dp", "sp")))),
         jax.device_put(nblocks, NamedSharding(mesh, P(("dp", "sp")))),
     )
+
+
+def make_aligned_step(mesh: Mesh, params):
+    """Multi-device **aligned CDC v2** step (the flagship pipeline,
+    dfs_tpu.ops.cdc_pipeline, sharded).
+
+    Strips chunk independently (ops.cdc_v2: chunking restarts at strip
+    boundaries), so the strip axis shards over the whole mesh with zero
+    halo traffic — the deliberate v2 contrast with the rolling pipeline
+    above, whose 31-byte window forces a ppermute ring. The only
+    collective is the psum that aggregates global chunk-count telemetry.
+
+    step(words_le [S, bps*16] u32 — strips sharded over ('dp','sp'),
+         real_blocks [S] i32 — same sharding)
+      -> (cutflag [bps, S] i32 (strips sharded on axis 1),
+          states [bps*8, S] u32 (same),
+          n_chunks [] i32 (global psum))
+    """
+    from dfs_tpu.ops.cdc_v2 import (gear_candidates_device,
+                                    select_cuts_device)
+    from dfs_tpu.ops.layout import bswap_transpose
+    from dfs_tpu.ops.sha256_strip import strip_states, strip_states_xla
+
+    on_tpu = all(d.platform == "tpu" for d in mesh.devices.flat)
+
+    def local_step(words_le, real_blocks):
+        words_t = bswap_transpose(words_le)           # local [bps*16, S/n]
+        cand = gear_candidates_device(words_t, params)
+        cutflag = select_cuts_device(cand, real_blocks, params)
+        cf32 = cutflag.astype(jnp.int32)
+        # Pallas wants a 128-multiple lane dim; shapes are static at trace
+        # time, so the local strip count decides per-compile.
+        use_pallas = on_tpu and words_t.shape[1] % 128 == 0
+        states = (strip_states if use_pallas else strip_states_xla)(
+            words_t, cf32)
+        n = jax.lax.psum(
+            jax.lax.psum(jnp.sum(cf32), "sp"), "dp")
+        return cf32, states, n
+
+    shard_fn = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(("dp", "sp")), P(("dp", "sp"))),
+        out_specs=(P(None, ("dp", "sp")), P(None, ("dp", "sp")), P()),
+        check_vma=False,
+    )
+    return jax.jit(shard_fn)
+
+
+def shard_aligned_inputs(mesh: Mesh, words_le: np.ndarray,
+                         real_blocks: np.ndarray):
+    """device_put aligned-step inputs with strip-axis sharding."""
+    return (
+        jax.device_put(words_le, NamedSharding(mesh, P(("dp", "sp")))),
+        jax.device_put(real_blocks, NamedSharding(mesh, P(("dp", "sp")))),
+    )

@@ -21,6 +21,7 @@ import tempfile
 from pathlib import Path
 
 from dfs_tpu.meta.manifest import Manifest
+from dfs_tpu.utils.hashing import is_hex_digest
 from dfs_tpu.utils.hashing import sha256_hex
 
 
@@ -47,7 +48,7 @@ class ChunkStore:
         self.root.mkdir(parents=True, exist_ok=True)
 
     def _path(self, digest: str) -> Path:
-        if len(digest) != 64 or any(c not in "0123456789abcdef" for c in digest):
+        if not is_hex_digest(digest):
             raise ValueError(f"bad digest {digest!r}")
         return self.root / digest[:2] / digest
 
@@ -103,7 +104,7 @@ class ManifestStore:
         self.root.mkdir(parents=True, exist_ok=True)
 
     def _path(self, file_id: str) -> Path:
-        if len(file_id) != 64 or any(c not in "0123456789abcdef" for c in file_id):
+        if not is_hex_digest(file_id):
             raise ValueError(f"bad file_id {file_id!r}")
         return self.root / f"{file_id}.json"
 

@@ -58,3 +58,15 @@ def gear_table(seed: int = 0x9E3779B9) -> np.ndarray:
             z = z ^ (z >> np.uint64(31))
             out[i] = z
     return (out & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
+def is_hex_digest(s: str) -> bool:
+    """True iff ``s`` is a 64-char lowercase-hex SHA-256 digest — the only
+    legal file/chunk id format (shared by the store and the HTTP layer so
+    the 400 gate and the ValueError gate cannot diverge)."""
+    return len(s) == 64 and all(c in "0123456789abcdef" for c in s)
+
+
+def next_pow2(x: int) -> int:
+    """Smallest power of two >= max(1, x)."""
+    return 1 << (max(1, x) - 1).bit_length()

@@ -15,21 +15,28 @@ import __graft_entry__  # noqa: E402
 def test_entry_compiles_and_runs():
     fn, args = __graft_entry__.entry()
     jitted = jax.jit(fn)
-    bitmap, tail, state = jitted(*args)
-    assert bitmap.shape == (args[0].shape[0],)
-    assert tail.shape == (31,)
-    assert state.shape == (args[3].shape[0], 8)
-    # digest rows must match hashlib for the example messages
-    import hashlib
-    from dfs_tpu.ops.sha256_jax import state_to_hex
-    # recover the example messages deterministically (same seed as entry())
-    rng = np.random.default_rng(0)
-    rng.integers(0, 256, size=64 * 1024, dtype=np.uint8)  # skip data draw
-    lens = rng.integers(1, 2048, size=32)
-    msgs = [rng.integers(0, 256, size=int(ln), dtype=np.uint8).tobytes()
-            for ln in lens]
-    assert state_to_hex(np.asarray(state)) == [
-        hashlib.sha256(m).hexdigest() for m in msgs]
+    cf32, states = jitted(*args)
+    words_le, real_blocks = args
+    s = words_le.shape[0]
+    bps = real_blocks[0]
+    assert cf32.shape == (bps, s)
+    assert states.shape == (bps * 8, s)
+
+    # cutflag must match the NumPy oracle on the recovered raw stream
+    from dfs_tpu.ops.cdc_v2 import (AlignedCdcParams, candidates_np,
+                                    select_cuts_blocks)
+    params = AlignedCdcParams(min_blocks=8, avg_blocks=32, max_blocks=128,
+                              strip_blocks=256)  # mirrors entry()
+    raw = np.ascontiguousarray(words_le).view(np.uint8)
+    cand = candidates_np(raw.reshape(-1), params)
+    cf = np.asarray(cf32)
+    for i in range(s):
+        pos = np.flatnonzero(
+            cand[i * params.strip_blocks:(i + 1) * params.strip_blocks])
+        cuts = select_cuts_blocks(pos, params.strip_blocks, params)
+        expect = np.zeros((params.strip_blocks,), np.int32)
+        expect[cuts - 1] = 1
+        assert np.array_equal(cf[:, i], expect), f"strip {i}"
 
 
 def test_dryrun_multichip_8():

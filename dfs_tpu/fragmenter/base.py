@@ -64,6 +64,16 @@ def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5) -> Fragm
 
     if kind == "fixed":
         return FixedFragmenter(parts=fixed_parts)
+    if kind in ("cdc-anchored", "cdc-anchored-tpu"):
+        from dfs_tpu.fragmenter.cdc_anchored import (AnchoredCpuFragmenter,
+                                                     AnchoredTpuFragmenter)
+        from dfs_tpu.ops.cdc_anchored import AnchoredCdcParams
+
+        params = cdc_params if isinstance(cdc_params, AnchoredCdcParams) \
+            else AnchoredCdcParams()
+        cls = AnchoredCpuFragmenter if kind == "cdc-anchored" \
+            else AnchoredTpuFragmenter
+        return cls(params)
     if kind in ("cdc-aligned", "cdc-aligned-tpu"):
         from dfs_tpu.fragmenter.cdc_aligned import (AlignedCpuFragmenter,
                                                     AlignedTpuFragmenter)

@@ -1,0 +1,172 @@
+"""Anchored two-level CDC fragmenters (v3) — shift-resilient + TPU-fast.
+
+Strategy (ops.cdc_anchored): byte-granular content anchors choose segment
+boundaries; within each segment the aligned 64-byte chunk grid re-anchors
+at the segment start, so unaligned insertions only disturb their own
+segment (the aligned v2 grid loses all downstream dedup — see
+fragmenter/cdc_aligned.py). Chunking is identical whether the stream is
+chunked whole, in any batching, or streamed: regions hand the device a
+tile-aligned window with 8 bytes of lookback, and the unfinished tail
+segment carries into the next region (ops.cdc_anchored.region_chunks).
+
+- ``AnchoredCpuFragmenter`` — NumPy oracle path (chunk_file_anchored_np).
+- ``AnchoredTpuFragmenter`` — full device pipeline, bounded-memory
+  streaming in ~regions of ``region_bytes``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dfs_tpu.fragmenter.base import Fragmenter
+from dfs_tpu.meta.manifest import ChunkRef, Manifest
+from dfs_tpu.ops.cdc_anchored import (TILE_BYTES, AnchoredCdcParams,
+                                      chunk_file_anchored_np, region_chunks)
+from dfs_tpu.ops.cdc_v2 import file_id_from_digests
+
+_REGION_BYTES = 64 * 1024 * 1024
+_CPU_CUTOFF = 2 * 1024 * 1024
+
+
+def _to_u8(data) -> np.ndarray:
+    if isinstance(data, np.ndarray):
+        return data
+    return np.frombuffer(data, dtype=np.uint8)
+
+
+class _AnchoredBase(Fragmenter):
+    def __init__(self, params: AnchoredCdcParams | None = None) -> None:
+        self.params = params or AnchoredCdcParams()
+
+    def manifest(self, data: bytes, name: str,
+                 file_id: str | None = None) -> Manifest:
+        chunks = tuple(self.chunk(data))
+        return Manifest(
+            file_id=file_id or file_id_from_digests(
+                [c.digest for c in chunks]),
+            name=name, size=len(data), fragmenter=self.name, chunks=chunks)
+
+
+class AnchoredCpuFragmenter(_AnchoredBase):
+    """NumPy oracle as the production CPU path."""
+
+    name = "cdc-anchored"
+
+    def chunk(self, data: bytes) -> list[ChunkRef]:
+        spans = chunk_file_anchored_np(_to_u8(data), self.params)
+        return [ChunkRef(index=i, offset=o, length=ln, digest=dg)
+                for i, (o, ln, dg) in enumerate(spans)]
+
+
+class AnchoredTpuFragmenter(_AnchoredBase):
+    """Device pipeline, region-batched; output is batching-independent."""
+
+    name = "cdc-anchored-tpu"
+
+    def __init__(self, params: AnchoredCdcParams | None = None,
+                 region_bytes: int = _REGION_BYTES,
+                 cpu_cutoff: int = _CPU_CUTOFF,
+                 lane_multiple: int = 128) -> None:
+        super().__init__(params)
+        if region_bytes < 2 * self.params.seg_max:
+            raise ValueError("region must hold at least two segments")
+        self.region_bytes = int(region_bytes)
+        self.cpu_cutoff = int(cpu_cutoff)
+        self.lane_multiple = int(lane_multiple)
+
+    # -- region walk shared by chunk() and manifest_stream() --------------
+
+    def _walk(self, arr: np.ndarray, store=None) -> list[ChunkRef]:
+        n = int(arr.shape[0])
+        if n == 0:
+            return []
+        if n <= self.cpu_cutoff:
+            spans = chunk_file_anchored_np(arr, self.params)
+            out = [ChunkRef(index=i, offset=o, length=ln, digest=dg)
+                   for i, (o, ln, dg) in enumerate(spans)]
+            if store is not None:
+                for c in out:
+                    store(c.digest,
+                          arr[c.offset:c.offset + c.length].tobytes())
+            return out
+
+        out: list[ChunkRef] = []
+        bound = 0                      # absolute offset of last boundary
+        while bound < n:
+            base = (bound // TILE_BYTES) * TILE_BYTES  # tile-aligned window
+            start0 = bound - base
+            end = min(n, base + self.region_bytes)
+            final = end == n
+            lookback = np.zeros((8,), np.uint8)
+            take = min(8, base)
+            if take:
+                lookback[8 - take:] = arr[base - take:base]
+            spans, consumed = region_chunks(
+                arr[base:end], lookback, start0, final, self.params,
+                lane_multiple=self.lane_multiple)
+            for o, ln, dg in spans:
+                c = ChunkRef(index=len(out), offset=base + o, length=ln,
+                             digest=dg)
+                out.append(c)
+                if store is not None:
+                    store(dg, arr[c.offset:c.offset + ln].tobytes())
+            new_bound = base + consumed
+            if new_bound <= bound:     # no progress would mean a bug
+                raise AssertionError("anchored region walk stalled")
+            bound = new_bound
+        return out
+
+    def chunk(self, data: bytes) -> list[ChunkRef]:
+        return self._walk(_to_u8(data))
+
+    def manifest_stream(self, blocks, name: str, store=None) -> Manifest:
+        """Bounded-memory streaming: buffer holds only the bytes past the
+        last emitted boundary (plus tile alignment + 8 lookback bytes);
+        full regions flush as the stream arrives. Output is identical to
+        chunk() on the concatenated stream by construction."""
+        chunks: list[ChunkRef] = []
+        buf = bytearray()
+        buf_base = 0                   # absolute offset of buf[0]
+        bound = 0                      # absolute last emitted boundary
+        total = 0                      # absolute bytes received
+
+        def run_region(final: bool) -> None:
+            nonlocal buf, buf_base, bound
+            base = (bound // TILE_BYTES) * TILE_BYTES
+            end = min(total, base + self.region_bytes)
+            arr = np.frombuffer(bytes(buf), dtype=np.uint8)
+            region = arr[base - buf_base:end - buf_base]
+            lb = np.zeros((8,), np.uint8)
+            take = min(8, base - buf_base)
+            if take:
+                lb[8 - take:] = arr[base - buf_base - take:base - buf_base]
+            spans, consumed = region_chunks(
+                region, lb, bound - base, final and end == total,
+                self.params, lane_multiple=self.lane_multiple)
+            for o, ln, dg in spans:
+                c = ChunkRef(index=len(chunks), offset=base + o, length=ln,
+                             digest=dg)
+                chunks.append(c)
+                if store is not None:
+                    store(dg, region[o:o + ln].tobytes())
+            if base + consumed <= bound and not (final and end == total):
+                raise AssertionError("anchored stream walk stalled")
+            bound = base + consumed
+            keep_from = max(buf_base,
+                            (bound // TILE_BYTES) * TILE_BYTES - 8)
+            if keep_from > buf_base:
+                del buf[:keep_from - buf_base]
+                buf_base = keep_from
+
+        for b in blocks:
+            buf += b
+            total += len(b)
+            while total - bound >= self.region_bytes:
+                run_region(final=False)
+        while bound < total:
+            run_region(final=True)
+
+        return Manifest(
+            file_id=file_id_from_digests([c.digest for c in chunks]),
+            name=name, size=total, fragmenter=self.name,
+            chunks=tuple(chunks))

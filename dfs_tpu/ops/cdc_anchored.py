@@ -1,0 +1,575 @@
+"""Anchored two-level CDC (v3) — shift-resilient dedup at TPU speed.
+
+The aligned v2 pipeline (ops.cdc_v2 / ops.cdc_pipeline) quantizes cuts to
+a 64-byte grid anchored at absolute stream offset 0; an insertion whose
+length is not a multiple of 64 shifts all downstream content off the grid
+and kills dedup (measured 1.16x vs 3.91x for byte-granular rolling CDC on
+the versioned corpus — bench_dedup.py). v3 re-anchors the grid with a
+classic two-level scheme:
+
+1. **Byte-granular anchors.** A cheap 8-byte windowed hash is evaluated at
+   EVERY byte position (elementwise over the four byte phases of the LE
+   word array — no rolling state, ~1 ms per 64 MiB on v5e):
+
+       b_p = LE32(bytes[p-3 .. p])     a_p = LE32(bytes[p-7 .. p-4])
+       h_p = fmix32(fmix32(b_p) + a_p)         (bytes before 0 read as 0)
+       anchor(p)  iff  h_p & seg_mask == 0
+
+   Anchors are quantized: only the FIRST anchor inside each absolute
+   ``TILE_BYTES`` tile survives (bounds device->host traffic to one i32
+   per tile; the drop is deterministic given content + alignment).
+
+2. **Segment selection** (host, metadata-sized, shared verbatim with the
+   oracle): segments end at the LAST kept anchor within
+   ``[start + seg_min, start + seg_max]`` — maximizing segment length keeps
+   device-lane utilization high — else forced at ``start + seg_max``.
+
+3. **Within a segment, the aligned v2 machinery runs with its 64-byte grid
+   anchored at the segment start**: the device repacks each segment into
+   its own lane (vmap'd dynamic_slice + per-lane byte funnel shift,
+   measured ~0.5 ms per 64 MiB), then candidates -> selection ->
+   strip-scan SHA-256 exactly as v2. A segment's chunking depends only on
+   the segment's bytes, and segment starts move WITH content — so an
+   insertion re-syncs at the next anchor and dedup survives.
+
+Segment tails are rarely 64-byte multiples, so each lane's final chunk
+ends in a partial block; its digest is finalized on device from the chain
+state before the tail block plus one or two patched FIPS blocks (the
+strip scan saw the tail zero-padded). Everything returning to the host is
+metadata-sized.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+
+import numpy as np
+
+from dfs_tpu.ops.cdc_v2 import (BLOCK, AlignedCdcParams, candidates_np,
+                                select_cuts_blocks)
+from dfs_tpu.utils.hashing import next_pow2
+
+_PRIME = np.uint32(0x9E3779B1)
+_M1 = np.uint32(0x7FEB352D)
+_M2 = np.uint32(0x846CA68B)
+
+TILE_BYTES = 512           # anchor quantization tile (absolute offsets).
+# Small on purpose: the kept anchor of a tile flips when a tile holds >1
+# true anchor and content shifts, so P(flip) ~ tile/mean_anchor_gap must
+# stay small or quantization itself destroys shift resilience (measured
+# 55% dedup-after-insert at tile=2048 with dense anchors vs >90% here).
+_NO_ANCHOR = np.int64(2**62)
+
+
+@dataclasses.dataclass(frozen=True)
+class AnchoredCdcParams:
+    """Two-level parameters: byte-granular segment anchoring over the
+    aligned chunk grid.
+
+    ``seg_mask`` fires with probability 2^-13 per byte (mean anchor gap
+    8 KiB), dense enough that the last-anchor-in-window rule lands a
+    boundary close to ``seg_max`` (measured ~96% lane utilization);
+    ``seg_max`` must equal ``chunk.strip_blocks * 64`` — a segment is one
+    device lane.
+    """
+    chunk: AlignedCdcParams = dataclasses.field(
+        default_factory=AlignedCdcParams)
+    seg_min: int = 96 * 1024
+    seg_max: int = 128 * 1024
+    seg_mask: int = 8191
+    seed: int = 0x51ED270B
+
+    def __post_init__(self):
+        if self.seg_max != self.chunk.strip_blocks * BLOCK:
+            raise ValueError("seg_max must equal one lane "
+                             f"({self.chunk.strip_blocks * BLOCK} B)")
+        if not 0 < self.seg_min <= self.seg_max:
+            raise ValueError("need 0 < seg_min <= seg_max")
+        if self.seg_mask & (self.seg_mask + 1):
+            raise ValueError("seg_mask must be 2^k - 1")
+        if TILE_BYTES > self.seg_min:
+            raise ValueError("anchor tile must not exceed seg_min")
+        if self.seg_min % TILE_BYTES or self.seg_max % TILE_BYTES:
+            raise ValueError("seg_min/seg_max must be multiples of "
+                             f"{TILE_BYTES} (device selection window)")
+
+
+# ---------------------------------------------------------------------------
+# anchor hash — NumPy oracle (vectorized; bit-identical to the device pass)
+# ---------------------------------------------------------------------------
+
+def _fmix32_np(x: np.ndarray) -> np.ndarray:
+    x = np.asarray(x, dtype=np.uint32)
+    x = x ^ (x >> np.uint32(16))
+    x = (x * _M1).astype(np.uint32)
+    x = x ^ (x >> np.uint32(15))
+    x = (x * _M2).astype(np.uint32)
+    return x ^ (x >> np.uint32(16))
+
+
+def anchor_hash_np(data: np.ndarray, params: AnchoredCdcParams) -> np.ndarray:
+    """h_p for every byte position p of ``data`` [n] u8 (bytes before the
+    stream read as zero)."""
+    n = data.shape[0]
+    padded = np.zeros((n + 8,), dtype=np.uint8)
+    padded[8:] = data
+    le = padded.astype(np.uint32)
+    # b_p = LE32(bytes[p-3..p]) built at padded index p+8
+    b = (le[5:n + 5] | (le[6:n + 6] << np.uint32(8))
+         | (le[7:n + 7] << np.uint32(16)) | (le[8:n + 8] << np.uint32(24)))
+    a = (le[1:n + 1] | (le[2:n + 2] << np.uint32(8))
+         | (le[3:n + 3] << np.uint32(16)) | (le[4:n + 4] << np.uint32(24)))
+    return _fmix32_np(_fmix32_np(b) + np.uint32(params.seed) + a)
+
+
+def kept_anchors_np(data: np.ndarray,
+                    params: AnchoredCdcParams) -> np.ndarray:
+    """Sorted kept anchor positions: first qualifying byte per TILE_BYTES
+    tile (the oracle of the device pass-A output)."""
+    n = data.shape[0]
+    if n == 0:
+        return np.zeros((0,), dtype=np.int64)
+    hit = (anchor_hash_np(data, params)
+           & np.uint32(params.seg_mask)) == 0
+    pos = np.flatnonzero(hit)
+    if pos.size == 0:
+        return pos.astype(np.int64)
+    tile = pos // TILE_BYTES
+    first = np.ones_like(pos, dtype=bool)
+    first[1:] = tile[1:] != tile[:-1]
+    return pos[first].astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# segment selection — ONE implementation, used by oracle and production
+# ---------------------------------------------------------------------------
+
+def select_segments(anchors: np.ndarray, n: int,
+                    params: AnchoredCdcParams) -> np.ndarray:
+    """Exclusive segment boundaries over a stream of ``n`` bytes; last
+    element == n. Boundary after byte p means segment ends at p (boundary
+    value p+1). Rule: LAST kept anchor with start+seg_min <= p+1 <=
+    start+seg_max; none -> forced at start+seg_max."""
+    bounds: list[int] = []
+    start = 0
+    ap = np.asarray(anchors, dtype=np.int64)
+    while n - start > params.seg_max:
+        lo = start + params.seg_min            # min admissible boundary
+        hi = start + params.seg_max            # forced boundary
+        # anchors p with lo <= p+1 <= hi  <=>  lo-1 <= p <= hi-1
+        j = int(np.searchsorted(ap, hi - 1, side="right")) - 1
+        if j >= 0 and ap[j] >= lo - 1:
+            b = int(ap[j]) + 1
+        else:
+            b = hi
+        bounds.append(b)
+        start = b
+    bounds.append(n)
+    return np.asarray(bounds, dtype=np.int64)
+
+
+# ---------------------------------------------------------------------------
+# full oracle: anchors -> segments -> aligned chunking per segment
+# ---------------------------------------------------------------------------
+
+def chunk_spans_anchored_np(data: np.ndarray, params: AnchoredCdcParams
+                            ) -> list[tuple[int, int]]:
+    """[(offset, length)] chunks; segment grid re-anchored per segment."""
+    n = data.shape[0]
+    if n == 0:
+        return []
+    bounds = select_segments(kept_anchors_np(data, params), n, params)
+    cp = params.chunk
+    spans: list[tuple[int, int]] = []
+    start = 0
+    for b in bounds.tolist():
+        seg = data[start:b]
+        ln = seg.shape[0]
+        nb = -(-ln // BLOCK)
+        pos = np.flatnonzero(candidates_np(seg, cp))
+        cuts = select_cuts_blocks(pos, nb, cp)
+        prev = 0
+        for c in cuts.tolist():
+            end = min(c * BLOCK, ln)
+            spans.append((start + prev * BLOCK, end - prev * BLOCK))
+            prev = c
+        start = b
+    return spans
+
+
+def chunk_file_anchored_np(data: np.ndarray, params: AnchoredCdcParams
+                           ) -> list[tuple[int, int, str]]:
+    mv = memoryview(np.ascontiguousarray(data))
+    return [(o, ln, hashlib.sha256(mv[o:o + ln]).hexdigest())
+            for o, ln in chunk_spans_anchored_np(data, params)]
+
+
+# ---------------------------------------------------------------------------
+# device pass A: anchor tile array
+# ---------------------------------------------------------------------------
+
+@functools.cache
+def make_anchor_fn(params: AnchoredCdcParams, m_words: int):
+    """Compiled: words_le [2 + m_words] u32 -> first-anchor byte position
+    per TILE_BYTES tile ([m_words*4/TILE_BYTES] i32; 2^30 = no anchor).
+    The leading 2 words are the 8 stream bytes BEFORE the region (zeros at
+    true stream start), so anchor hashes near the region start see real
+    history and batching is transparent; positions are region-local."""
+    import jax
+    import jax.numpy as jnp
+
+    tile_w = TILE_BYTES // 4
+    seed = jnp.uint32(params.seed)
+    mask = jnp.uint32(params.seg_mask)
+
+    def fmix(x):
+        x = x ^ (x >> jnp.uint32(16))
+        x = x * jnp.uint32(_M1)
+        x = x ^ (x >> jnp.uint32(15))
+        x = x * jnp.uint32(_M2)
+        return x ^ (x >> jnp.uint32(16))
+
+    @jax.jit
+    def run(words):
+        # b over region words -1..m-1 (one extra so a = b shifted one word)
+        v, vp = words[1:], words[:-1]
+        best = jnp.full((m_words,), jnp.int32(2**30))
+        for r in range(4):
+            if r == 3:
+                b_all = v
+            else:
+                b_all = ((vp >> jnp.uint32(8 * (r + 1)))
+                         | (v << jnp.uint32(8 * (3 - r))))
+            b = b_all[1:]
+            a = b_all[:-1]
+            h = fmix(fmix(b) + seed + a)
+            hit = (h & mask) == 0
+            pos = jnp.arange(m_words, dtype=jnp.int32) * 4 + r
+            best = jnp.minimum(best, jnp.where(hit, pos, 2**30))
+        return jnp.min(best.reshape(-1, tile_w), axis=1)
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# device segment selection (mirrors select_segments bit-for-bit)
+# ---------------------------------------------------------------------------
+
+@functools.cache
+def make_select_fn(params: AnchoredCdcParams, m_tiles: int, cap: int):
+    """Compiled: (tiles [m_tiles] i32 — pass-A output, n i32) ->
+    bounds [cap] i32: exclusive segment boundaries in stream order, the
+    final one == n, -1 padding after it. A sequential scan with a
+    fixed-width window gather per step — the walk is tiny (cap ~ hundreds)
+    so only the boundary list ever reaches the host."""
+    import jax
+    import jax.numpy as jnp
+
+    win = (params.seg_max - params.seg_min) // TILE_BYTES + 1
+    seg_min = jnp.int32(params.seg_min)
+    seg_max = jnp.int32(params.seg_max)
+
+    @jax.jit
+    def run(tiles, start0, n, final):
+        """start0: region-local carry start; final: stream ends at n. For
+        a non-final region the tail segment is NOT emitted (its bytes
+        carry into the next region)."""
+        tiles_p = jnp.concatenate(
+            [tiles, jnp.full((win,), 2**30, jnp.int32)])
+
+        def body(carry, _):
+            start, done = carry
+            lo = start + seg_min
+            hi = start + seg_max
+            t0 = (lo - 1) // jnp.int32(TILE_BYTES)
+            w = jax.lax.dynamic_slice(tiles_p, (t0,), (win,))
+            valid = (w >= lo - 1) & (w <= hi - 1)
+            last = jnp.max(jnp.where(valid, w, -1))
+            b = jnp.where(last >= 0, last + 1, hi)
+            fin = n - start <= seg_max
+            b = jnp.where(fin, n, b)
+            # non-final regions keep the tail segment as carry: emit
+            # nothing once the remaining bytes fit in one segment
+            out = jnp.where(done | (fin & ~final), -1, b)
+            return (jnp.where(out >= 0, b, start), done | fin), out
+
+        _, bounds = jax.lax.scan(
+            body, (start0.astype(jnp.int32), jnp.bool_(False)), None,
+            length=cap)
+        return bounds
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# device pass B: repack segments into lanes + aligned chunk/hash
+# ---------------------------------------------------------------------------
+
+@functools.cache
+def make_anchored_segment_fn(params: AnchoredCdcParams, m_words: int,
+                             s_pad: int):
+    """Compiled: (words_le [m_words] u32 — the resident batch,
+    w_off [s_pad] i32 (word floor of each segment start),
+    sh8 [s_pad] u32 (8 * (start % 4)),
+    real_blocks [s_pad] i32 (ceil(seg_len/64); 0 = padding lane),
+    tail_len [s_pad] i32 (seg_len % 64; 0 = whole-block tail))
+    -> (count i32, q [c_max] i32 (lane*bps + t, -1 pad),
+        lens [c_max] i32 (chunk BYTE length), digests [c_max, 8] u32)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dfs_tpu.ops.cdc_v2 import (gear_candidates_device,
+                                    select_cuts_device)
+    from dfs_tpu.ops.layout import bswap32, bswap_transpose
+    from dfs_tpu.ops.sha256_jax import _H0
+    from dfs_tpu.ops.sha256_strip import (_compress_dispatch,
+                                          gather_cut_states,
+                                          pad_finalize_device, strip_states,
+                                          strip_states_xla)
+
+    cp = params.chunk
+    bps = cp.strip_blocks
+    lane_words = bps * 16
+    from dfs_tpu.ops.cdc_pipeline import cut_capacity
+    c_max = cut_capacity(s_pad, cp)
+    use_pallas = s_pad % 128 == 0 and any(
+        d.platform == "tpu" for d in jax.devices())
+    t_tile = 128 if bps % 128 == 0 else bps
+    k_max = t_tile // cp.min_blocks + 2
+
+    @jax.jit
+    def scan_half(words, w_off, sh8, real_blocks):
+        # repack: one lane per segment (dynamic_slice measured ~120 GiB/s
+        # on v5e), then funnel-shift each lane to its byte phase
+        x = jax.vmap(lambda o: jax.lax.dynamic_slice(
+            words, (o,), (lane_words + 1,)))(w_off)    # [s_pad, LW+1]
+        sh = sh8[:, None]
+        packed = jnp.where(
+            sh == 0, x[:, :-1],
+            (x[:, :-1] >> sh) | (x[:, 1:] << (jnp.uint32(32) - sh)))
+
+        words_t = bswap_transpose(packed)              # [bps*16, s_pad] BE
+        cand = gear_candidates_device(words_t, cp)
+        cutflag, since = select_cuts_device(cand, real_blocks, cp)
+        cf32 = cutflag.astype(jnp.int32)
+        states = (strip_states if use_pallas else strip_states_xla)(
+            words_t, cf32)
+        return packed, cf32, since, states
+
+    @jax.jit
+    def compact_half(packed, cf32, since, states, w_off, sh8, real_blocks,
+                     tail_len):
+        count = jnp.sum(cf32)
+
+        # cut positions, tile-extracted (see ops.cdc_pipeline)
+        flat = cf32.T.reshape(-1, t_tile) != 0
+        nt = flat.shape[0]
+        iota = jnp.arange(t_tile, dtype=jnp.int32)[None, :]
+        cnt = jnp.sum(flat, axis=1).astype(jnp.int32)
+        base = jnp.cumsum(cnt) - cnt
+        poss = []
+        cur = flat
+        for _ in range(k_max):
+            pos = jnp.min(jnp.where(cur, iota, t_tile), axis=1)
+            poss.append(pos)
+            cur = cur & (iota != pos[:, None])
+        pos_mat = jnp.stack(poss, axis=1)
+        valid = pos_mat < t_tile
+        gidx = jnp.where(
+            valid,
+            base[:, None] + jnp.arange(k_max, dtype=jnp.int32)[None, :],
+            c_max)
+        vals = jnp.arange(nt, dtype=jnp.int32)[:, None] * t_tile + pos_mat
+        q = jnp.full((c_max,), -1, jnp.int32).at[gidx.reshape(-1)].set(
+            vals.reshape(-1).astype(jnp.int32), mode="drop")
+
+        t = jnp.maximum(q, 0) % bps
+        s = jnp.maximum(q, 0) // bps
+
+        # chunk lengths come from the selection's own block counter (lanes
+        # are independent segments, so cross-lane position diffs — the v2
+        # trick — do not apply); the lane-tail chunk subtracts its pad
+        blocks = jnp.take(since.reshape(-1),
+                          t * jnp.int32(s_pad) + s)    # since is [bps, S]
+        is_tail = (t == jnp.take(real_blocks, s) - 1) \
+            & (jnp.take(tail_len, s) > 0)
+        lens = blocks * jnp.int32(BLOCK) \
+            - jnp.where(is_tail, jnp.int32(BLOCK) - jnp.take(tail_len, s), 0)
+
+        cut_states = gather_cut_states(states, t * jnp.int32(s_pad) + s,
+                                       s_pad)
+        digests = pad_finalize_device(cut_states, lens)
+
+        # ---- lane-tail digests: the strip scan compressed a zero-padded
+        # partial block; redo the final block(s) with real FIPS padding ----
+        tl = tail_len                                   # [s_pad]
+        last_t = jnp.maximum(real_blocks - 1, 0)
+        # chain state BEFORE the tail block (H0 when the tail chunk is a
+        # single partial block)
+        tail_since = jnp.take(since.reshape(-1),
+                              last_t * jnp.int32(s_pad)
+                              + jnp.arange(s_pad, dtype=jnp.int32))
+        prev_states = gather_cut_states(
+            states, (last_t - 1) * jnp.int32(s_pad)
+            + jnp.arange(s_pad, dtype=jnp.int32), s_pad)
+        single = (tail_since <= 1)[:, None]
+        h0 = jnp.broadcast_to(jnp.asarray(_H0)[None, :], prev_states.shape)
+        state0 = jnp.where(single, h0, prev_states)    # [s_pad, 8]
+
+        # tail block content (LE), masked beyond tail_len, 0x80 appended
+        widx = (last_t * 16)[:, None] \
+            + jnp.arange(16, dtype=jnp.int32)[None, :]
+        tw = jnp.take_along_axis(packed, widx, axis=1)  # [s_pad, 16] LE
+        byte0 = jnp.arange(16, dtype=jnp.int32)[None, :] * 4  # word's byte
+        keep = jnp.clip(tl[:, None] - byte0, 0, 4)
+        mask = jnp.where(keep >= 4, jnp.uint32(0xFFFFFFFF),
+                         (jnp.uint32(1) << (jnp.uint32(8) *
+                                            keep.astype(jnp.uint32)))
+                         - jnp.uint32(1))
+        tw = tw & mask
+        in_word = (tl[:, None] // 4) == jnp.arange(16, dtype=jnp.int32)[None, :]
+        tw = tw | jnp.where(
+            in_word,
+            jnp.uint32(0x80) << (jnp.uint32(8) *
+                                 (tl % 4).astype(jnp.uint32))[:, None],
+            jnp.uint32(0))
+        twb = [bswap32(tw[:, i]) for i in range(16)]    # BE words
+
+        tail_bytes = (tail_since - 1) * jnp.int32(BLOCK) + tl
+        bits_lo = tail_bytes.astype(jnp.uint32) * jnp.uint32(8)
+        bits_hi = tail_bytes.astype(jnp.uint32) >> jnp.uint32(29)
+
+        # fits: tail_len <= 55 -> length goes in the same block
+        fits = tl <= 55
+        w_fit = list(twb)
+        w_fit[14] = jnp.where(fits, bits_hi, twb[14])
+        w_fit[15] = jnp.where(fits, bits_lo, twb[15])
+        d_fit = jnp.stack(
+            _compress_dispatch([state0[:, i] for i in range(8)], w_fit),
+            axis=1)
+        # overflow: content block, then a pure length block
+        st2 = jnp.stack(
+            _compress_dispatch([state0[:, i] for i in range(8)], list(twb)),
+            axis=1)
+        zero = jnp.zeros_like(bits_lo)
+        w_len = [zero] * 14 + [bits_hi, bits_lo]
+        d_ovf = jnp.stack(
+            _compress_dispatch([st2[:, i] for i in range(8)], w_len),
+            axis=1)
+        tail_digest = jnp.where(fits[:, None], d_fit, d_ovf)  # [s_pad, 8]
+
+        digests = jnp.where(is_tail[:, None],
+                            jnp.take(tail_digest, jnp.maximum(s, 0), axis=0),
+                            digests)
+        return count, q, lens, digests
+
+    def run(words, w_off, sh8, real_blocks, tail_len):
+        packed, cf32, since, states = scan_half(words, w_off, sh8,
+                                                real_blocks)
+        return compact_half(packed, cf32, since, states, w_off, sh8,
+                            real_blocks, tail_len)
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# host driver: one resident batch -> chunk table
+# ---------------------------------------------------------------------------
+
+def region_chunks(data: np.ndarray, lookback: np.ndarray, start0: int,
+                  final: bool, params: AnchoredCdcParams,
+                  lane_multiple: int = 128
+                  ) -> tuple[list[tuple[int, int, str]], int]:
+    """Chunk one stream region on device.
+
+    data: [n] u8 region bytes (byte 0 = stream offset ``base``, any base);
+    lookback: [8] u8 — the 8 stream bytes before the region (zeros at true
+    stream start); start0: carry position inside the region (bytes before
+    it belong to already-emitted segments of a previous region); final:
+    True iff the stream ends at data[-1] — otherwise the tail segment is
+    withheld so its bytes can carry into the next region.
+
+    Returns ([(region_offset, length, sha256hex)], consumed): chunks of
+    every emitted segment, and the region offset up to which segments were
+    emitted (== n when final). Batching is transparent: for any region
+    split the concatenated output equals the whole-stream oracle
+    (chunk_file_anchored_np), which tests enforce.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from dfs_tpu.ops.cdc_pipeline import digests_to_hex
+
+    n = int(data.shape[0])
+    if n == 0:
+        return [], 0
+
+    # resident region: [8 lookback bytes][region padded to whole tiles]
+    # plus one full lane + funnel word of slack so every lane's
+    # dynamic_slice stays in bounds (jax clamps out-of-range slice starts,
+    # which would silently shift a tail segment's content)
+    m_words = next_pow2(-(-n // TILE_BYTES)) * (TILE_BYTES // 4)
+    buf = np.zeros((8 + m_words * 4 + params.seg_max + 4,), dtype=np.uint8)
+    buf[:8] = lookback
+    buf[8:8 + n] = data
+    words = jax.device_put(buf.view("<u4"))
+
+    m_tiles = m_words * 4 // TILE_BYTES
+    cap = m_words * 4 // params.seg_min + 1
+    tiles = make_anchor_fn(params, m_words)(words[:2 + m_words])
+    bounds_dev = np.asarray(make_select_fn(params, m_tiles, cap)(
+        tiles, jnp.int32(start0), jnp.int32(n), jnp.bool_(final)))
+    bounds = bounds_dev[bounds_dev >= 0].astype(np.int64)
+    if bounds.shape[0] == 0:
+        return [], int(start0)
+    consumed = int(bounds[-1])
+
+    starts = np.concatenate([[start0], bounds[:-1]])
+    seg_lens = bounds - starts
+    s_real = starts.shape[0]
+    s_pad = max(lane_multiple, next_pow2(s_real))
+
+    w_off = np.zeros((s_pad,), np.int32)
+    sh8 = np.zeros((s_pad,), np.uint32)
+    real_blocks = np.zeros((s_pad,), np.int32)
+    tail_len = np.zeros((s_pad,), np.int32)
+    w_off[:s_real] = starts // 4 + 2       # +2: the 8 lookback bytes
+    sh8[:s_real] = (starts % 4) * 8
+    real_blocks[:s_real] = -(-seg_lens // BLOCK)
+    tail_len[:s_real] = seg_lens % BLOCK
+
+    run = make_anchored_segment_fn(params, int(words.shape[0]), s_pad)
+    count, q, lens, dig = run(words, jax.device_put(jnp.asarray(w_off)),
+                              jax.device_put(jnp.asarray(sh8)),
+                              jax.device_put(jnp.asarray(real_blocks)),
+                              jax.device_put(jnp.asarray(tail_len)))
+    count = int(np.asarray(count))
+    q = np.asarray(q)[:count].astype(np.int64)
+    lens = np.asarray(lens)[:count].astype(np.int64)
+    dig = np.asarray(dig)[:count]
+    if count and (q < 0).any():
+        raise AssertionError("anchored cut compaction overflowed a tile")
+
+    # lane-local cut block t + segment table -> region spans. Cuts arrive
+    # lane-major (q = s*bps + t) and segments are stream-ordered lanes, so
+    # the list is already in stream order.
+    bps = params.chunk.strip_blocks
+    s = q // bps
+    t = q % bps
+    ends = starts[s] + np.minimum((t + 1) * BLOCK, seg_lens[s])
+    offs = ends - lens
+    hexes = digests_to_hex(dig)
+    return [(int(o), int(ln), h)
+            for o, ln, h in zip(offs, lens, hexes)], consumed
+
+
+def batch_chunks_anchored(data: np.ndarray, params: AnchoredCdcParams,
+                          lane_multiple: int = 128
+                          ) -> list[tuple[int, int, str]]:
+    """Whole-stream convenience wrapper over :func:`region_chunks`."""
+    chunks, _ = region_chunks(
+        np.asarray(data), np.zeros((8,), np.uint8), 0, True, params,
+        lane_multiple=lane_multiple)
+    return chunks

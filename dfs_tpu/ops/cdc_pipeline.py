@@ -91,7 +91,7 @@ def make_segment_fn(params: AlignedCdcParams, s_real: int, s_pad: int):
             words_t = jnp.pad(words_t, ((0, 0), (0, s_pad - s_real)))
 
         cand = gear_candidates_device(words_t, params)
-        cutflag = select_cuts_device(cand, real_blocks, params)
+        cutflag, _ = select_cuts_device(cand, real_blocks, params)
         cf32 = cutflag.astype(jnp.int32)
         states = (strip_states if use_pallas else strip_states_xla)(
             words_t, cf32)

@@ -265,9 +265,10 @@ def select_cuts_device(cand, real_blocks, params: AlignedCdcParams,
     """Lane-parallel greedy selection.
 
     cand: [bps, S] bool; real_blocks: [S] int32 — complete-or-partial blocks
-    actually present in each strip (0 for padding strips). Returns cutflag
-    [bps, S] bool — True after the last block of each chunk. Bit-exact vs
-    select_cuts_blocks per strip.
+    actually present in each strip (0 for padding strips). Returns
+    (cutflag [bps, S] bool — True after the last block of each chunk,
+    since [bps, S] int32 — at cut positions, the cut chunk's length in
+    blocks; 0 elsewhere). Bit-exact vs select_cuts_blocks per strip.
 
     The walk is sequential by definition; ``unroll`` blocks advance per scan
     step (identical math, unrolled on registers) because per-step dispatch
@@ -289,18 +290,20 @@ def select_cuts_device(cand, real_blocks, params: AlignedCdcParams,
         is_last = t == real_blocks - 1                 # strip/file end
         cut = ((cand_t & (since1 >= min_b)) | (since1 >= max_b) | is_last) \
             & in_range
-        return jnp.where(cut, 0, jnp.where(in_range, since1, since)), cut
+        nxt = jnp.where(cut, 0, jnp.where(in_range, since1, since))
+        return nxt, cut, jnp.where(cut, since1, 0)
 
     def body(since, xs):
         cand_u, t_u = xs                               # [u, S], [u]
-        outs = []
+        cuts, lens = [], []
         for j in range(u):
-            since, cut = step(since, cand_u[j], t_u[j])
-            outs.append(cut)
-        return since, jnp.stack(outs)
+            since, cut, ln = step(since, cand_u[j], t_u[j])
+            cuts.append(cut)
+            lens.append(ln)
+        return since, (jnp.stack(cuts), jnp.stack(lens))
 
-    _, cutflag = jax.lax.scan(
+    _, (cutflag, since) = jax.lax.scan(
         body, jnp.zeros((s,), jnp.int32),
         (cand.reshape(bps // u, u, s),
          jnp.arange(bps, dtype=jnp.int32).reshape(bps // u, u)))
-    return cutflag.reshape(bps, s)
+    return cutflag.reshape(bps, s), since.reshape(bps, s)

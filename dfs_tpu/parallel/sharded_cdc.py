@@ -118,7 +118,7 @@ def make_aligned_step(mesh: Mesh, params):
     def local_step(words_le, real_blocks):
         words_t = bswap_transpose(words_le)           # local [bps*16, S/n]
         cand = gear_candidates_device(words_t, params)
-        cutflag = select_cuts_device(cand, real_blocks, params)
+        cutflag, _ = select_cuts_device(cand, real_blocks, params)
         cf32 = cutflag.astype(jnp.int32)
         # Pallas wants a 128-multiple lane dim; shapes are static at trace
         # time, so the local strip count decides per-compile.

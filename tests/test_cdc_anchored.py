@@ -1,0 +1,167 @@
+"""Anchored two-level CDC (v3): oracle properties, device parity, and the
+shift-resilience the aligned v2 grid lacks."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from dfs_tpu.ops.cdc_anchored import (TILE_BYTES, AnchoredCdcParams,
+                                      anchor_hash_np, batch_chunks_anchored,
+                                      chunk_file_anchored_np,
+                                      chunk_spans_anchored_np,
+                                      kept_anchors_np, select_segments)
+from dfs_tpu.ops.cdc_v2 import AlignedCdcParams
+
+SMALL = AnchoredCdcParams(
+    chunk=AlignedCdcParams(min_blocks=2, avg_blocks=4, max_blocks=16,
+                           strip_blocks=64),           # 4 KiB lanes
+    seg_min=2048, seg_max=4096, seg_mask=2047)
+
+
+def corpus(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, size=n,
+                                                dtype=np.uint8)
+
+
+# ---------------------------------------------------------------- oracle --
+
+def test_anchor_hash_window_is_8_bytes():
+    # changing byte p-8 must not affect h_p; changing p-7..p must
+    data = corpus(64, seed=1)
+    h = anchor_hash_np(data, SMALL)
+    p = 40
+    d2 = data.copy()
+    d2[p - 8] ^= 0xFF
+    assert anchor_hash_np(d2, SMALL)[p] == h[p]
+    d3 = data.copy()
+    d3[p - 7] ^= 0xFF
+    assert anchor_hash_np(d3, SMALL)[p] != h[p]
+
+
+def test_kept_anchors_one_per_tile():
+    data = corpus(200000, seed=2)
+    kept = kept_anchors_np(data, SMALL)
+    tiles = kept // TILE_BYTES
+    assert len(set(tiles.tolist())) == len(kept)
+    assert np.all(np.diff(kept) > 0)
+
+
+def test_segments_respect_bounds():
+    data = corpus(300000, seed=3)
+    bounds = select_segments(kept_anchors_np(data, SMALL),
+                             data.shape[0], SMALL)
+    assert bounds[-1] == data.shape[0]
+    prev = 0
+    for b in bounds[:-1].tolist():
+        assert SMALL.seg_min <= b - prev <= SMALL.seg_max
+        prev = b
+    assert bounds[-1] - prev <= SMALL.seg_max
+
+
+def test_spans_tile_stream_and_match_hashlib():
+    for n in (1, 63, 65, 5000, 100001):
+        data = corpus(n, seed=n)
+        spans = chunk_spans_anchored_np(data, SMALL)
+        assert spans[0][0] == 0
+        assert sum(ln for _, ln in spans) == n
+        for (o1, l1), (o2, _) in zip(spans, spans[1:]):
+            assert o1 + l1 == o2
+    chunks = chunk_file_anchored_np(corpus(50000, seed=9), SMALL)
+    data = corpus(50000, seed=9)
+    for o, ln, dg in chunks:
+        assert dg == hashlib.sha256(data[o:o + ln].tobytes()).hexdigest()
+
+
+def test_shift_resilience_vs_aligned():
+    """The defining property: after an unaligned insertion, most chunks
+    must still dedup (the v2 aligned grid loses everything downstream)."""
+    base = corpus(300000, seed=4)
+    edited = np.concatenate(
+        [base[:50001], corpus(77, seed=5), base[50001:]])
+    a = {dg for _, _, dg in chunk_file_anchored_np(base, SMALL)}
+    b = [(o, ln, dg) for o, ln, dg in chunk_file_anchored_np(edited, SMALL)]
+    shared = sum(ln for _, ln, dg in b if dg in a)
+    assert shared / edited.shape[0] > 0.85, \
+        f"only {shared / edited.shape[0]:.0%} of bytes deduped after insert"
+
+
+# ---------------------------------------------------------- device parity --
+
+@pytest.mark.parametrize("n", [1, 63, 4096, 5000, 100001, 300000])
+def test_device_matches_oracle(n):
+    data = corpus(n, seed=n + 100)
+    got = batch_chunks_anchored(data, SMALL, lane_multiple=8)
+    want = chunk_file_anchored_np(data, SMALL)
+    assert got == want
+
+
+def test_device_low_entropy():
+    # all-zeros: anchor hash is constant; whatever it decides, device and
+    # oracle must agree, max-size forcing must bound segments
+    data = np.zeros((100000,), dtype=np.uint8)
+    got = batch_chunks_anchored(data, SMALL, lane_multiple=8)
+    want = chunk_file_anchored_np(data, SMALL)
+    assert got == want
+    # repeating pattern (anchor-dense)
+    data = np.tile(corpus(256, seed=6), 400)
+    assert batch_chunks_anchored(data, SMALL, lane_multiple=8) == \
+        chunk_file_anchored_np(data, SMALL)
+
+
+def test_device_tail_digests():
+    # segment tails end in partial blocks — the device finalize path must
+    # agree with hashlib for every chunk, including tails >= 56 bytes mod 64
+    for seed in range(3):
+        data = corpus(37777 + seed * 1111, seed=seed + 20)
+        for o, ln, dg in batch_chunks_anchored(data, SMALL, lane_multiple=8):
+            assert dg == hashlib.sha256(
+                data[o:o + ln].tobytes()).hexdigest()
+
+
+# ----------------------------------------------------------- fragmenters --
+
+def anchored_frag(**kw):
+    from dfs_tpu.fragmenter.cdc_anchored import AnchoredTpuFragmenter
+
+    return AnchoredTpuFragmenter(SMALL, region_bytes=16384, cpu_cutoff=0,
+                                 lane_multiple=8, **kw)
+
+
+def test_fragmenter_matches_oracle_and_cpu():
+    from dfs_tpu.fragmenter.cdc_anchored import AnchoredCpuFragmenter
+
+    data = corpus(100000, seed=40).tobytes()
+    tpu = anchored_frag().chunk(data)
+    cpu = AnchoredCpuFragmenter(SMALL).chunk(data)
+    assert tpu == cpu
+    assert sum(c.length for c in tpu) == len(data)
+
+
+def test_region_walk_transparent():
+    # region_bytes small forces many carries; result must equal one-shot
+    data = corpus(120000, seed=41).tobytes()
+    big = anchored_frag(region_bytes=1 << 30)
+    small = anchored_frag()
+    assert big.chunk(data) == small.chunk(data)
+
+
+def test_streaming_matches_chunk_any_blocking():
+    data = corpus(90000, seed=42).tobytes()
+    frag = anchored_frag()
+    want = frag.manifest(data, name="f")
+    for bs in (1000, 8192, 30000):
+        stored = {}
+        blocks = [data[i:i + bs] for i in range(0, len(data), bs)]
+        got = frag.manifest_stream(
+            blocks, name="f", store=lambda dg, b: stored.setdefault(dg, b))
+        assert got.chunks == want.chunks
+        assert got.file_id == want.file_id
+        assert b"".join(stored[c.digest] for c in got.chunks) == data
+
+
+def test_factory_anchored_kinds():
+    from dfs_tpu.fragmenter.base import get_fragmenter
+
+    assert get_fragmenter("cdc-anchored").name == "cdc-anchored"
+    assert get_fragmenter("cdc-anchored-tpu").name == "cdc-anchored-tpu"

@@ -124,8 +124,8 @@ def test_device_tail_digests():
 def anchored_frag(**kw):
     from dfs_tpu.fragmenter.cdc_anchored import AnchoredTpuFragmenter
 
-    return AnchoredTpuFragmenter(SMALL, region_bytes=16384, cpu_cutoff=0,
-                                 lane_multiple=8, **kw)
+    kw.setdefault("region_bytes", 16384)
+    return AnchoredTpuFragmenter(SMALL, cpu_cutoff=0, lane_multiple=8, **kw)
 
 
 def test_fragmenter_matches_oracle_and_cpu():
@@ -144,6 +144,24 @@ def test_region_walk_transparent():
     big = anchored_frag(region_bytes=1 << 30)
     small = anchored_frag()
     assert big.chunk(data) == small.chunk(data)
+
+
+def test_three_way_region_streaming_equality():
+    """Large-region one-shot == tiny-region walk == streaming, and all
+    equal the NumPy whole-stream oracle — the transparency property the
+    region/carry design exists to guarantee."""
+    arr = corpus(200000, seed=43)
+    data = arr.tobytes()
+    want = [(o, ln, dg) for o, ln, dg in chunk_file_anchored_np(arr, SMALL)]
+
+    one_shot = anchored_frag(region_bytes=1 << 30).chunk(data)
+    tiny_frag = anchored_frag()            # 16 KiB regions: many carries
+    tiny = tiny_frag.chunk(data)
+    blocks = [data[i:i + 7333] for i in range(0, len(data), 7333)]
+    streamed = tiny_frag.manifest_stream(blocks, name="f").chunks
+
+    for got in (one_shot, tiny, list(streamed)):
+        assert [(c.offset, c.length, c.digest) for c in got] == want
 
 
 def test_streaming_matches_chunk_any_blocking():

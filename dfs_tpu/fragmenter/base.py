@@ -55,6 +55,26 @@ class Fragmenter(abc.ABC):
         return m
 
 
+def _aligned_from_cdc(cdc_params):
+    """CDCParams byte sizes -> 64-byte block units (quantized); grow the
+    strip to fit large --max-chunk values (strips must hold at least one
+    max-size chunk, and stay 128-block-aligned for the device compaction
+    tiling)."""
+    from dfs_tpu.ops.cdc_v2 import AlignedCdcParams
+
+    max_blocks = max(1, cdc_params.max_size // 64)
+    default_strip = AlignedCdcParams.__dataclass_fields__[
+        "strip_blocks"].default
+    strip_blocks = default_strip
+    while strip_blocks < max_blocks:
+        strip_blocks *= 2
+    return AlignedCdcParams(
+        min_blocks=max(1, cdc_params.min_size // 64),
+        avg_blocks=max(1, cdc_params.avg_size // 64),
+        max_blocks=max_blocks,
+        strip_blocks=strip_blocks)
+
+
 def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5) -> Fragmenter:
     """Factory keyed by NodeConfig.fragmenter."""
     from dfs_tpu.config import CDCParams
@@ -67,10 +87,23 @@ def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5) -> Fragm
     if kind in ("cdc-anchored", "cdc-anchored-tpu"):
         from dfs_tpu.fragmenter.cdc_anchored import (AnchoredCpuFragmenter,
                                                      AnchoredTpuFragmenter)
-        from dfs_tpu.ops.cdc_anchored import AnchoredCdcParams
+        from dfs_tpu.ops.cdc_anchored import TILE_BYTES, AnchoredCdcParams
 
-        params = cdc_params if isinstance(cdc_params, AnchoredCdcParams) \
-            else AnchoredCdcParams()
+        if isinstance(cdc_params, AnchoredCdcParams):
+            params = cdc_params
+        elif cdc_params is not None:
+            # operator chunk sizing (NodeConfig.cdc is always a CDCParams)
+            # must reach the nested aligned grid — the segment level scales
+            # with it: seg_max is pinned to one lane (strip bytes) and
+            # seg_min keeps the default 3:4 ratio, tile-aligned.
+            chunk = _aligned_from_cdc(cdc_params)
+            seg_max = chunk.strip_blocks * 64
+            seg_min = max(TILE_BYTES,
+                          (3 * seg_max // 4) // TILE_BYTES * TILE_BYTES)
+            params = AnchoredCdcParams(chunk=chunk, seg_min=seg_min,
+                                       seg_max=seg_max)
+        else:
+            params = AnchoredCdcParams()
         cls = AnchoredCpuFragmenter if kind == "cdc-anchored" \
             else AnchoredTpuFragmenter
         return cls(params)
@@ -82,21 +115,7 @@ def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5) -> Fragm
         if isinstance(cdc_params, AlignedCdcParams):
             params = cdc_params
         elif cdc_params is not None:
-            # CDCParams byte sizes -> 64-byte block units (quantized); grow
-            # the strip to fit large --max-chunk values (strips must hold at
-            # least one max-size chunk, and stay 128-block-aligned for the
-            # device compaction tiling).
-            max_blocks = max(1, cdc_params.max_size // 64)
-            default_strip = AlignedCdcParams.__dataclass_fields__[
-                "strip_blocks"].default
-            strip_blocks = default_strip
-            while strip_blocks < max_blocks:
-                strip_blocks *= 2
-            params = AlignedCdcParams(
-                min_blocks=max(1, cdc_params.min_size // 64),
-                avg_blocks=max(1, cdc_params.avg_size // 64),
-                max_blocks=max_blocks,
-                strip_blocks=strip_blocks)
+            params = _aligned_from_cdc(cdc_params)
         else:
             params = AlignedCdcParams()
         cls = AlignedCpuFragmenter if kind == "cdc-aligned" \

@@ -9,6 +9,15 @@ chunked whole, in any batching, or streamed: regions hand the device a
 tile-aligned window with 8 bytes of lookback, and the unfinished tail
 segment carries into the next region (ops.cdc_anchored.region_chunks).
 
+The TPU walk is **pipelined**: windows advance by a fixed tile-aligned
+stride (region_bytes - seg_max — always far enough that the carry lands
+inside the next window), so every window's bytes are known upfront and
+window k+1 can be device_put while window k computes; the carry position
+chains as a DEVICE scalar (consumed_k - stride), so a multi-region stream
+runs with zero host syncs until results are collected. This is the
+host->HBM staging overlap the reference's synchronous upload loop
+(StorageNode.java:118-189) has no analogue of.
+
 - ``AnchoredCpuFragmenter`` — NumPy oracle path (chunk_file_anchored_np).
 - ``AnchoredTpuFragmenter`` — full device pipeline, bounded-memory
   streaming in ~regions of ``region_bytes``.
@@ -21,7 +30,9 @@ import numpy as np
 from dfs_tpu.fragmenter.base import Fragmenter
 from dfs_tpu.meta.manifest import ChunkRef, Manifest
 from dfs_tpu.ops.cdc_anchored import (TILE_BYTES, AnchoredCdcParams,
-                                      chunk_file_anchored_np, region_chunks)
+                                      chunk_file_anchored_np, region_buffer,
+                                      region_chunks, region_collect,
+                                      region_dispatch)
 from dfs_tpu.ops.cdc_v2 import file_id_from_digests
 
 _REGION_BYTES = 64 * 1024 * 1024
@@ -66,15 +77,61 @@ class AnchoredTpuFragmenter(_AnchoredBase):
     def __init__(self, params: AnchoredCdcParams | None = None,
                  region_bytes: int = _REGION_BYTES,
                  cpu_cutoff: int = _CPU_CUTOFF,
-                 lane_multiple: int = 128) -> None:
+                 lane_multiple: int = 128,
+                 max_inflight: int = 2) -> None:
         super().__init__(params)
+        region_bytes = (int(region_bytes) // TILE_BYTES) * TILE_BYTES
         if region_bytes < 2 * self.params.seg_max:
             raise ValueError("region must hold at least two segments")
-        self.region_bytes = int(region_bytes)
+        self.region_bytes = region_bytes
+        # fixed window stride: far enough that the previous window's carry
+        # (>= window_end - seg_max) always lands inside the next window
+        self.stride = region_bytes - self.params.seg_max
         self.cpu_cutoff = int(cpu_cutoff)
         self.lane_multiple = int(lane_multiple)
+        self.max_inflight = max(1, int(max_inflight))
 
-    # -- region walk shared by chunk() and manifest_stream() --------------
+    # -- pipelined region walk shared by chunk() and manifest_stream() ----
+
+    def _dispatch_window(self, arr: np.ndarray, base: int, n: int,
+                         start0) -> tuple:
+        """device_put window [base, min(n, base+region_bytes)) and dispatch
+        the fused chain; returns (base, out) with out all device arrays.
+        ``arr`` must hold absolute stream bytes [>= base-8, end).
+        Buffer shapes bucket to the next power of two (region_buffer), so a
+        multi-window walk compiles once for the full windows plus at most
+        once for the shorter tail window."""
+        import jax
+
+        end = min(n, base + self.region_bytes)
+        lookback = np.zeros((8,), np.uint8)
+        take = min(8, base)
+        if take:
+            lookback[8 - take:] = arr[base - take:base]
+        words = jax.device_put(region_buffer(
+            arr[base:end], lookback, self.params))
+        out = region_dispatch(words, end - base, start0, end == n,
+                              self.params, lane_multiple=self.lane_multiple)
+        return base, out
+
+    def _collect_window(self, base: int, out, arr: np.ndarray,
+                        chunks: list[ChunkRef], store) -> int:
+        """Pull one window's results, append absolute-offset ChunkRefs;
+        returns the absolute consumed bound. Verifies span contiguity (the
+        device-chained carry has no per-region host check)."""
+        spans, consumed = region_collect(out)
+        expect = chunks[-1].offset + chunks[-1].length if chunks else 0
+        for o, ln, dg in spans:
+            off = base + o
+            if off != expect:
+                raise AssertionError(
+                    f"anchored walk discontinuity at {off} (want {expect})")
+            expect = off + ln
+            c = ChunkRef(index=len(chunks), offset=off, length=ln, digest=dg)
+            chunks.append(c)
+            if store is not None:
+                store(dg, arr[off:off + ln].tobytes())
+        return base + consumed
 
     def _walk(self, arr: np.ndarray, store=None) -> list[ChunkRef]:
         n = int(arr.shape[0])
@@ -90,31 +147,26 @@ class AnchoredTpuFragmenter(_AnchoredBase):
                           arr[c.offset:c.offset + c.length].tobytes())
             return out
 
-        out: list[ChunkRef] = []
-        bound = 0                      # absolute offset of last boundary
-        while bound < n:
-            base = (bound // TILE_BYTES) * TILE_BYTES  # tile-aligned window
-            start0 = bound - base
-            end = min(n, base + self.region_bytes)
-            final = end == n
-            lookback = np.zeros((8,), np.uint8)
-            take = min(8, base)
-            if take:
-                lookback[8 - take:] = arr[base - take:base]
-            spans, consumed = region_chunks(
-                arr[base:end], lookback, start0, final, self.params,
-                lane_multiple=self.lane_multiple)
-            for o, ln, dg in spans:
-                c = ChunkRef(index=len(out), offset=base + o, length=ln,
-                             digest=dg)
-                out.append(c)
-                if store is not None:
-                    store(dg, arr[c.offset:c.offset + ln].tobytes())
-            new_bound = base + consumed
-            if new_bound <= bound:     # no progress would mean a bug
-                raise AssertionError("anchored region walk stalled")
-            bound = new_bound
-        return out
+        chunks: list[ChunkRef] = []
+        pending: list[tuple] = []      # [(base, device outputs)]
+        start0 = 0                     # int for window 0, device scalar after
+        base = 0
+        while True:
+            if len(pending) >= self.max_inflight:   # cap live windows
+                self._collect_window(*pending.pop(0), arr, chunks, store)
+            b, out = self._dispatch_window(arr, base, n, start0)
+            pending.append((b, out))
+            final = base + self.region_bytes >= n
+            if final:
+                break
+            start0 = out[0] - self.stride   # device-resident carry
+            base += self.stride
+        bound = 0
+        for b, out in pending:
+            bound = self._collect_window(b, out, arr, chunks, store)
+        if bound != n:
+            raise AssertionError(f"anchored walk ended at {bound} != {n}")
+        return chunks
 
     def chunk(self, data: bytes) -> list[ChunkRef]:
         return self._walk(_to_u8(data))

@@ -304,6 +304,46 @@ def make_select_fn(params: AnchoredCdcParams, m_tiles: int, cap: int):
 
 
 # ---------------------------------------------------------------------------
+# device segment descriptors: bounds -> lane tables (keeps the chain fused)
+# ---------------------------------------------------------------------------
+
+@functools.cache
+def make_descriptor_fn(params: AnchoredCdcParams, cap: int, s_pad: int):
+    """Compiled: (bounds [cap] i32 — select output, start0 i32) ->
+    (starts [s_pad], seg_lens [s_pad], w_off [s_pad], sh8 [s_pad] u32,
+     real_blocks [s_pad], tail_len [s_pad], consumed i32).
+
+    Everything pass B needs, derived on device — the round-1 design pulled
+    ``bounds`` to the host to build these arrays, which put a tunnel/PCIe
+    sync in the middle of every region and capped the walk at ~0.4 GiB/s;
+    fused, the anchor->select->descriptor->chunk/hash chain dispatches
+    asynchronously end to end."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(bounds, start0):
+        valid = bounds >= 0
+        starts = jnp.concatenate(
+            [start0[None].astype(jnp.int32), bounds[:-1]])
+        starts = jnp.where(valid, starts, 0)
+        seg_lens = jnp.where(valid, bounds - starts, 0)
+        pad = s_pad - cap
+        starts_p = jnp.pad(starts, (0, pad))
+        seg_lens_p = jnp.pad(seg_lens, (0, pad))
+        w_off = starts_p // jnp.int32(4) + jnp.int32(2)
+        sh8 = ((starts_p % jnp.int32(4)) * jnp.int32(8)).astype(jnp.uint32)
+        real_blocks = (seg_lens_p + jnp.int32(BLOCK - 1)) // jnp.int32(BLOCK)
+        tail_len = seg_lens_p % jnp.int32(BLOCK)
+        consumed = jnp.max(jnp.where(valid, bounds,
+                                     start0.astype(jnp.int32)))
+        return (starts_p, seg_lens_p, w_off, sh8, real_blocks, tail_len,
+                consumed)
+
+    return run
+
+
+# ---------------------------------------------------------------------------
 # device pass B: repack segments into lanes + aligned chunk/hash
 # ---------------------------------------------------------------------------
 
@@ -314,8 +354,10 @@ def make_anchored_segment_fn(params: AnchoredCdcParams, m_words: int,
     w_off [s_pad] i32 (word floor of each segment start),
     sh8 [s_pad] u32 (8 * (start % 4)),
     real_blocks [s_pad] i32 (ceil(seg_len/64); 0 = padding lane),
-    tail_len [s_pad] i32 (seg_len % 64; 0 = whole-block tail))
+    tail_len [s_pad] i32 (seg_len % 64; 0 = whole-block tail),
+    starts [s_pad] i32, seg_lens [s_pad] i32 (region-local byte table))
     -> (count i32, q [c_max] i32 (lane*bps + t, -1 pad),
+        offs [c_max] i32 (region-local chunk byte offsets),
         lens [c_max] i32 (chunk BYTE length), digests [c_max, 8] u32)."""
     import jax
     import jax.numpy as jnp
@@ -325,7 +367,7 @@ def make_anchored_segment_fn(params: AnchoredCdcParams, m_words: int,
     from dfs_tpu.ops.layout import bswap32, bswap_transpose
     from dfs_tpu.ops.sha256_jax import _H0
     from dfs_tpu.ops.sha256_strip import (_compress_dispatch,
-                                          gather_cut_states,
+                                          cut_state_rows,
                                           pad_finalize_device, strip_states,
                                           strip_states_xla)
 
@@ -333,7 +375,13 @@ def make_anchored_segment_fn(params: AnchoredCdcParams, m_words: int,
     bps = cp.strip_blocks
     lane_words = bps * 16
     from dfs_tpu.ops.cdc_pipeline import cut_capacity
-    c_max = cut_capacity(s_pad, cp)
+    # capacity: per-lane bound AND the global bound — segments tile the
+    # region disjointly, so total content blocks <= region blocks + one
+    # rounded-up tail per lane, and cuts <= blocks/min + one forced
+    # lane-final cut per lane (1.5x tighter than the per-lane bound alone
+    # at default params; the finalize + gathers scale with c_max)
+    c_max = min(cut_capacity(s_pad, cp),
+                (m_words // 16 + s_pad) // cp.min_blocks + s_pad)
     use_pallas = s_pad % 128 == 0 and any(
         d.platform == "tpu" for d in jax.devices())
     t_tile = 128 if bps % 128 == 0 else bps
@@ -356,11 +404,13 @@ def make_anchored_segment_fn(params: AnchoredCdcParams, m_words: int,
         cf32 = cutflag.astype(jnp.int32)
         states = (strip_states if use_pallas else strip_states_xla)(
             words_t, cf32)
-        return packed, cf32, since, states
+        # states relayout here (not in compact) so the 50 MB transpose
+        # stays in the module XLA already fuses the scan into
+        return cf32, since, cut_state_rows(states, s_pad)
 
     @jax.jit
-    def compact_half(packed, cf32, since, states, w_off, sh8, real_blocks,
-                     tail_len):
+    def compact_half(cf32, since, state_rows, words, w_off, sh8,
+                     real_blocks, tail_len, starts, seg_lens):
         count = jnp.sum(cf32)
 
         # cut positions, tile-extracted (see ops.cdc_pipeline)
@@ -398,8 +448,7 @@ def make_anchored_segment_fn(params: AnchoredCdcParams, m_words: int,
         lens = blocks * jnp.int32(BLOCK) \
             - jnp.where(is_tail, jnp.int32(BLOCK) - jnp.take(tail_len, s), 0)
 
-        cut_states = gather_cut_states(states, t * jnp.int32(s_pad) + s,
-                                       s_pad)
+        cut_states = jnp.take(state_rows, t * jnp.int32(s_pad) + s, axis=0)
         digests = pad_finalize_device(cut_states, lens)
 
         # ---- lane-tail digests: the strip scan compressed a zero-padded
@@ -408,20 +457,27 @@ def make_anchored_segment_fn(params: AnchoredCdcParams, m_words: int,
         last_t = jnp.maximum(real_blocks - 1, 0)
         # chain state BEFORE the tail block (H0 when the tail chunk is a
         # single partial block)
+        lane_i = jnp.arange(s_pad, dtype=jnp.int32)
         tail_since = jnp.take(since.reshape(-1),
-                              last_t * jnp.int32(s_pad)
-                              + jnp.arange(s_pad, dtype=jnp.int32))
-        prev_states = gather_cut_states(
-            states, (last_t - 1) * jnp.int32(s_pad)
-            + jnp.arange(s_pad, dtype=jnp.int32), s_pad)
+                              last_t * jnp.int32(s_pad) + lane_i)
+        prev_states = jnp.take(
+            state_rows,
+            jnp.maximum((last_t - 1) * jnp.int32(s_pad) + lane_i, 0), axis=0)
         single = (tail_since <= 1)[:, None]
         h0 = jnp.broadcast_to(jnp.asarray(_H0)[None, :], prev_states.shape)
         state0 = jnp.where(single, h0, prev_states)    # [s_pad, 8]
 
-        # tail block content (LE), masked beyond tail_len, 0x80 appended
-        widx = (last_t * 16)[:, None] \
-            + jnp.arange(16, dtype=jnp.int32)[None, :]
-        tw = jnp.take_along_axis(packed, widx, axis=1)  # [s_pad, 16] LE
+        # tail block content (LE) regathered from the region buffer (the
+        # repacked lanes are not kept — dropping the 96 MiB intermediate
+        # output pays for this 17-word-per-lane gather many times over),
+        # masked beyond tail_len, 0x80 appended
+        widx = w_off[:, None] + (last_t * 16)[:, None] \
+            + jnp.arange(17, dtype=jnp.int32)[None, :]
+        x = jnp.take(words, widx)                       # [s_pad, 17]
+        sh = sh8[:, None]
+        tw = jnp.where(sh == 0, x[:, :-1],
+                       (x[:, :-1] >> sh)
+                       | (x[:, 1:] << (jnp.uint32(32) - sh)))
         byte0 = jnp.arange(16, dtype=jnp.int32)[None, :] * 4  # word's byte
         keep = jnp.clip(tl[:, None] - byte0, 0, 4)
         mask = jnp.where(keep >= 4, jnp.uint32(0xFFFFFFFF),
@@ -463,13 +519,17 @@ def make_anchored_segment_fn(params: AnchoredCdcParams, m_words: int,
         digests = jnp.where(is_tail[:, None],
                             jnp.take(tail_digest, jnp.maximum(s, 0), axis=0),
                             digests)
-        return count, q, lens, digests
 
-    def run(words, w_off, sh8, real_blocks, tail_len):
-        packed, cf32, since, states = scan_half(words, w_off, sh8,
-                                                real_blocks)
-        return compact_half(packed, cf32, since, states, w_off, sh8,
-                            real_blocks, tail_len)
+        # region-local byte spans, on device (rows past count are garbage)
+        ends = jnp.take(starts, s) + jnp.minimum(
+            (t + 1) * jnp.int32(BLOCK), jnp.take(seg_lens, s))
+        offs = ends - lens
+        return count, q, offs, lens, digests
+
+    def run(words, w_off, sh8, real_blocks, tail_len, starts, seg_lens):
+        cf32, since, state_rows = scan_half(words, w_off, sh8, real_blocks)
+        return compact_half(cf32, since, state_rows, words, w_off, sh8,
+                            real_blocks, tail_len, starts, seg_lens)
 
     return run
 
@@ -477,6 +537,88 @@ def make_anchored_segment_fn(params: AnchoredCdcParams, m_words: int,
 # ---------------------------------------------------------------------------
 # host driver: one resident batch -> chunk table
 # ---------------------------------------------------------------------------
+
+def region_buffer(data: np.ndarray, lookback: np.ndarray,
+                  params: AnchoredCdcParams,
+                  m_words: int | None = None) -> np.ndarray:
+    """Host-side staging buffer for one region:
+    [8 lookback bytes][region padded to whole tiles] plus one full lane +
+    funnel word of slack so every lane's dynamic_slice stays in bounds
+    (jax clamps out-of-range slice starts, which would silently shift a
+    tail segment's content). Returned as the LE u32 view device_put wants.
+    Pass ``m_words`` to pin the shape (one compile across a region walk)."""
+    n = int(data.shape[0])
+    if m_words is None:
+        m_words = next_pow2(-(-n // TILE_BYTES)) * (TILE_BYTES // 4)
+    buf = np.zeros((8 + m_words * 4 + params.seg_max + 4,), dtype=np.uint8)
+    buf[:8] = lookback
+    buf[8:8 + n] = data
+    return buf.view("<u4")
+
+
+@functools.lru_cache(maxsize=256)
+def _dev_i32(v: int):
+    import jax.numpy as jnp
+
+    return jnp.int32(v)
+
+
+@functools.lru_cache(maxsize=2)
+def _dev_bool(v: bool):
+    import jax.numpy as jnp
+
+    return jnp.bool_(v)
+
+
+def region_dispatch(words, n: int, start0, final: bool,
+                    params: AnchoredCdcParams, lane_multiple: int = 128):
+    """Dispatch the fused anchor->select->descriptor->chunk/hash chain on a
+    device-resident region buffer (``words`` from :func:`region_buffer`,
+    already device_put). ``start0`` may be a host int or a device scalar —
+    a device scalar keeps a multi-region walk entirely free of host syncs
+    (the carry chains on device). Returns device arrays
+    (consumed i32, count i32, q, offs, lens, digests); nothing blocks.
+
+    The n/start0/final scalars are cached device constants — re-putting
+    them per region measured ~4 ms each over a tunneled link (dispatch is
+    otherwise fully async)."""
+    import jax
+
+    m_words = (int(words.shape[0]) - 2 - (params.seg_max + 4) // 4)
+    m_tiles = m_words * 4 // TILE_BYTES
+    cap = m_words * 4 // params.seg_min + 1
+    s_pad = -(-cap // lane_multiple) * lane_multiple
+    if not isinstance(start0, jax.Array):
+        start0 = _dev_i32(int(start0))
+
+    tiles = make_anchor_fn(params, m_words)(words[:2 + m_words])
+    bounds = make_select_fn(params, m_tiles, cap)(
+        tiles, start0, _dev_i32(int(n)), _dev_bool(bool(final)))
+    (starts, seg_lens, w_off, sh8, real_blocks, tail_len,
+     consumed) = make_descriptor_fn(params, cap, s_pad)(bounds, start0)
+    count, q, offs, lens, dig = make_anchored_segment_fn(
+        params, int(words.shape[0]), s_pad)(
+        words, w_off, sh8, real_blocks, tail_len, starts, seg_lens)
+    return consumed, count, q, offs, lens, dig
+
+
+def region_collect(out) -> tuple[list[tuple[int, int, str]], int]:
+    """Pull a :func:`region_dispatch` result to the host and format it:
+    ([(region_offset, length, sha256hex)], consumed). The only sync point
+    of the chain."""
+    import jax
+
+    from dfs_tpu.ops.cdc_pipeline import digests_to_hex
+
+    consumed, count, q, offs, lens, dig = jax.device_get(out)
+    count = int(count)
+    if count and (q[:count] < 0).any():
+        raise AssertionError("anchored cut compaction overflowed a tile")
+    hexes = digests_to_hex(dig[:count])
+    return [(int(o), int(ln), h)
+            for o, ln, h in zip(offs[:count], lens[:count], hexes)], \
+        int(consumed)
+
 
 def region_chunks(data: np.ndarray, lookback: np.ndarray, start0: int,
                   final: bool, params: AnchoredCdcParams,
@@ -498,71 +640,14 @@ def region_chunks(data: np.ndarray, lookback: np.ndarray, start0: int,
     (chunk_file_anchored_np), which tests enforce.
     """
     import jax
-    import jax.numpy as jnp
-
-    from dfs_tpu.ops.cdc_pipeline import digests_to_hex
 
     n = int(data.shape[0])
     if n == 0:
         return [], 0
-
-    # resident region: [8 lookback bytes][region padded to whole tiles]
-    # plus one full lane + funnel word of slack so every lane's
-    # dynamic_slice stays in bounds (jax clamps out-of-range slice starts,
-    # which would silently shift a tail segment's content)
-    m_words = next_pow2(-(-n // TILE_BYTES)) * (TILE_BYTES // 4)
-    buf = np.zeros((8 + m_words * 4 + params.seg_max + 4,), dtype=np.uint8)
-    buf[:8] = lookback
-    buf[8:8 + n] = data
-    words = jax.device_put(buf.view("<u4"))
-
-    m_tiles = m_words * 4 // TILE_BYTES
-    cap = m_words * 4 // params.seg_min + 1
-    tiles = make_anchor_fn(params, m_words)(words[:2 + m_words])
-    bounds_dev = np.asarray(make_select_fn(params, m_tiles, cap)(
-        tiles, jnp.int32(start0), jnp.int32(n), jnp.bool_(final)))
-    bounds = bounds_dev[bounds_dev >= 0].astype(np.int64)
-    if bounds.shape[0] == 0:
-        return [], int(start0)
-    consumed = int(bounds[-1])
-
-    starts = np.concatenate([[start0], bounds[:-1]])
-    seg_lens = bounds - starts
-    s_real = starts.shape[0]
-    s_pad = max(lane_multiple, next_pow2(s_real))
-
-    w_off = np.zeros((s_pad,), np.int32)
-    sh8 = np.zeros((s_pad,), np.uint32)
-    real_blocks = np.zeros((s_pad,), np.int32)
-    tail_len = np.zeros((s_pad,), np.int32)
-    w_off[:s_real] = starts // 4 + 2       # +2: the 8 lookback bytes
-    sh8[:s_real] = (starts % 4) * 8
-    real_blocks[:s_real] = -(-seg_lens // BLOCK)
-    tail_len[:s_real] = seg_lens % BLOCK
-
-    run = make_anchored_segment_fn(params, int(words.shape[0]), s_pad)
-    count, q, lens, dig = run(words, jax.device_put(jnp.asarray(w_off)),
-                              jax.device_put(jnp.asarray(sh8)),
-                              jax.device_put(jnp.asarray(real_blocks)),
-                              jax.device_put(jnp.asarray(tail_len)))
-    count = int(np.asarray(count))
-    q = np.asarray(q)[:count].astype(np.int64)
-    lens = np.asarray(lens)[:count].astype(np.int64)
-    dig = np.asarray(dig)[:count]
-    if count and (q < 0).any():
-        raise AssertionError("anchored cut compaction overflowed a tile")
-
-    # lane-local cut block t + segment table -> region spans. Cuts arrive
-    # lane-major (q = s*bps + t) and segments are stream-ordered lanes, so
-    # the list is already in stream order.
-    bps = params.chunk.strip_blocks
-    s = q // bps
-    t = q % bps
-    ends = starts[s] + np.minimum((t + 1) * BLOCK, seg_lens[s])
-    offs = ends - lens
-    hexes = digests_to_hex(dig)
-    return [(int(o), int(ln), h)
-            for o, ln, h in zip(offs, lens, hexes)], consumed
+    words = jax.device_put(region_buffer(data, lookback, params))
+    out = region_dispatch(words, n, start0, final, params,
+                          lane_multiple=lane_multiple)
+    return region_collect(out)
 
 
 def batch_chunks_anchored(data: np.ndarray, params: AnchoredCdcParams,

@@ -145,3 +145,122 @@ def shard_aligned_inputs(mesh: Mesh, words_le: np.ndarray,
         jax.device_put(words_le, NamedSharding(mesh, P(("dp", "sp")))),
         jax.device_put(real_blocks, NamedSharding(mesh, P(("dp", "sp")))),
     )
+
+
+# ---------------------------------------------------------------------------
+# anchored v3, sharded — the flagship's multi-device step
+# ---------------------------------------------------------------------------
+
+def make_anchored_anchor_step(mesh: Mesh, params, m_local: int):
+    """Sharded **pass A** of the anchored pipeline (ops.cdc_anchored):
+    the byte-granular anchor hash is elementwise, so the stream shards over
+    the whole mesh as overlapping word spans with a 2-word (8-byte)
+    lookback halo — prepared host-side by :func:`shard_anchor_inputs`, so
+    no collective is needed at all (the halo is baked into each device's
+    span, the anchored analogue of the rolling pipeline's ppermute ring).
+
+    step(spans [n_dev, 2 + m_local] u32) -> tiles [n_dev * tiles_local]
+    i32 (first-anchor byte position per TILE_BYTES tile, region-local).
+    """
+    from dfs_tpu.ops.cdc_anchored import TILE_BYTES, make_anchor_fn
+
+    local_fn = make_anchor_fn(params, m_local)
+    tiles_local = m_local * 4 // TILE_BYTES
+
+    def local_step(span):
+        # span: [1, 2 + m_local] on this device; positions are local to
+        # the span — rebase to region offsets with the device index.
+        dev = jax.lax.axis_index("dp") * mesh.shape["sp"] \
+            + jax.lax.axis_index("sp")
+        tiles = local_fn(span[0])
+        return (tiles + jnp.where(tiles < 2**30,
+                                  dev * jnp.int32(m_local * 4),
+                                  0))[None, :]
+
+    shard_fn = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(("dp", "sp"), None),),
+        out_specs=P(("dp", "sp"), None),
+        check_vma=False,
+    )
+    return jax.jit(lambda spans: shard_fn(spans).reshape(
+        mesh.devices.size * tiles_local))
+
+
+def shard_anchor_inputs(mesh: Mesh, words: np.ndarray, m_local: int):
+    """Build the overlapped per-device spans for pass A from a region
+    buffer (ops.cdc_anchored.region_buffer layout: 2 lookback words then
+    the region). Device d gets words [d*m_local, (d+1)*m_local] plus its
+    2-word lookback — the overlap is 8 bytes per device boundary."""
+    n_dev = mesh.devices.size
+    spans = np.zeros((n_dev, 2 + m_local), dtype=np.uint32)
+    for d in range(n_dev):
+        lo = d * m_local
+        spans[d] = words[lo:lo + 2 + m_local]
+    return jax.device_put(
+        spans, NamedSharding(mesh, P(("dp", "sp"), None)))
+
+
+def make_anchored_step(mesh: Mesh, params):
+    """Sharded **pass B** of the anchored pipeline: segments are fully
+    independent lanes (the 64-byte chunk grid restarts at each segment
+    start), so the segment axis shards over the whole mesh with zero halo
+    traffic — same contrast with the rolling ppermute ring as the aligned
+    step above. The region words stay replicated (every device repacks its
+    own lanes by dynamic_slice; on a real pod the region would ride dp and
+    only lane descriptors shard). The only collective is the chunk-count
+    psum.
+
+    step(words [W] u32 — replicated region buffer,
+         w_off/sh8/real_blocks/tail_len [s_pad] — sharded over ('dp','sp'))
+      -> (cutflag [bps, s_pad] i32 (lanes sharded on axis 1),
+          since [bps, s_pad] i32 (same),
+          n_chunks [] i32 (global psum))
+    """
+    from dfs_tpu.ops.cdc_v2 import (gear_candidates_device,
+                                    select_cuts_device)
+    from dfs_tpu.ops.layout import bswap_transpose
+    from dfs_tpu.ops.sha256_strip import strip_states, strip_states_xla
+
+    cp = params.chunk
+    lane_words = cp.strip_blocks * 16
+    on_tpu = all(d.platform == "tpu" for d in mesh.devices.flat)
+
+    def local_step(words, w_off, sh8, real_blocks):
+        x = jax.vmap(lambda o: jax.lax.dynamic_slice(
+            words, (o,), (lane_words + 1,)))(w_off)
+        sh = sh8[:, None]
+        packed = jnp.where(
+            sh == 0, x[:, :-1],
+            (x[:, :-1] >> sh) | (x[:, 1:] << (jnp.uint32(32) - sh)))
+        words_t = bswap_transpose(packed)
+        cand = gear_candidates_device(words_t, cp)
+        cutflag, since = select_cuts_device(cand, real_blocks, cp)
+        cf32 = cutflag.astype(jnp.int32)
+        use_pallas = on_tpu and words_t.shape[1] % 128 == 0
+        states = (strip_states if use_pallas else strip_states_xla)(
+            words_t, cf32)
+        n = jax.lax.psum(jax.lax.psum(jnp.sum(cf32), "sp"), "dp")
+        return cf32, since, states, n
+
+    shard_fn = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), P(("dp", "sp")), P(("dp", "sp")), P(("dp", "sp")),),
+        out_specs=(P(None, ("dp", "sp")), P(None, ("dp", "sp")),
+                   P(None, ("dp", "sp")), P()),
+        check_vma=False,
+    )
+    return jax.jit(shard_fn)
+
+
+def shard_anchored_inputs(mesh: Mesh, words: np.ndarray, w_off: np.ndarray,
+                          sh8: np.ndarray, real_blocks: np.ndarray):
+    """device_put anchored pass-B inputs: words replicated, lane
+    descriptor arrays sharded over the flattened mesh."""
+    lane = NamedSharding(mesh, P(("dp", "sp")))
+    return (
+        jax.device_put(words, NamedSharding(mesh, P())),
+        jax.device_put(w_off, lane),
+        jax.device_put(sh8, lane),
+        jax.device_put(real_blocks, lane),
+    )

@@ -198,9 +198,11 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--replication-factor", type=int, default=None)
     serve.add_argument("--data-root", default="data")
     serve.add_argument(
-        "--fragmenter", default="cdc",
-        choices=["fixed", "cdc", "cdc-tpu", "cdc-aligned", "cdc-aligned-tpu",
-                 "cdc-anchored", "cdc-anchored-tpu"])
+        "--fragmenter", default="auto",
+        choices=["auto", "fixed", "cdc", "cdc-tpu", "cdc-aligned",
+                 "cdc-aligned-tpu", "cdc-anchored", "cdc-anchored-tpu"],
+        help="default 'auto': the flagship anchored pipeline — TPU device "
+             "path when a TPU is present, CPU oracle otherwise")
     serve.add_argument("--min-chunk", type=int, default=2048)
     serve.add_argument("--avg-chunk", type=int, default=8192)
     serve.add_argument("--max-chunk", type=int, default=65536)

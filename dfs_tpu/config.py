@@ -145,8 +145,10 @@ class NodeConfig:
     node_id: int
     cluster: ClusterConfig
     data_root: Path
-    fragmenter: str = "cdc"        # "fixed" | "cdc" | "cdc-tpu" |
-                                   # "cdc-aligned" | "cdc-aligned-tpu"
+    fragmenter: str = "auto"       # "auto" (flagship: anchored, TPU when
+                                   # present) | "fixed" | "cdc" | "cdc-tpu"
+                                   # | "cdc-aligned[-tpu]"
+                                   # | "cdc-anchored[-tpu]"
     cdc: CDCParams = dataclasses.field(default_factory=CDCParams)
     fixed_parts: int = 5           # FixedFragmenter part count (reference: TOTAL_NODES=5)
     connect_timeout_s: float = 2.0  # reference: 2000 ms, StorageNode.java:229-230
@@ -154,10 +156,14 @@ class NodeConfig:
     retries: int = 3               # reference: 3 attempts, StorageNode.java:208,320
     health_probe_s: float = 5.0    # peer health probe interval; 0 = data-path
                                    # feedback only (no background loop)
-    # Write policy: the reference aborts the whole upload if ANY peer is down
-    # (StorageNode.java:218-221) — write-all. We default to quorum=1 remote
-    # copy with background repair (SURVEY.md §5.3 build note).
-    write_quorum: int = 1
+    # Write policy: the reference aborts the whole upload if ANY peer is
+    # down (StorageNode.java:218-221) — write-all, guaranteeing 2 copies or
+    # failure. Quorum 2 (counting the local copy) keeps that >=2-copies
+    # durability; sloppy-quorum handoff in upload() keeps availability as
+    # long as any 2 nodes are reachable, and repair restores canonical
+    # placement. quorum=1 would return 201 with a single copy in the world
+    # when every peer is down — weaker than the reference (VERDICT r1 §6).
+    write_quorum: int = 2
 
     @property
     def self_addr(self) -> PeerAddr:

@@ -55,6 +55,32 @@ class Fragmenter(abc.ABC):
         return m
 
 
+def tpu_available(timeout_s: float = 15.0) -> bool:
+    """True iff a TPU backend comes up within ``timeout_s``.
+
+    Probed in a daemon thread because a stale device tunnel can hang JAX
+    backend init indefinitely (this harness's axon plugin does exactly
+    that) — on timeout the prober thread is abandoned and the caller falls
+    back to the CPU path. Monkeypatch this in tests to pin the decision.
+    """
+    import threading
+
+    out: dict[str, bool] = {}
+
+    def probe() -> None:
+        try:
+            import jax
+
+            out["tpu"] = any(d.platform == "tpu" for d in jax.devices())
+        except Exception:  # noqa: BLE001 - any init failure means no TPU
+            out["tpu"] = False
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return out.get("tpu", False)
+
+
 def _aligned_from_cdc(cdc_params):
     """CDCParams byte sizes -> 64-byte block units (quantized); grow the
     strip to fit large --max-chunk values (strips must hold at least one
@@ -76,12 +102,19 @@ def _aligned_from_cdc(cdc_params):
 
 
 def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5) -> Fragmenter:
-    """Factory keyed by NodeConfig.fragmenter."""
+    """Factory keyed by NodeConfig.fragmenter. ``"auto"`` (the serve
+    default) resolves to the flagship anchored pipeline: the TPU device
+    path when a TPU is present, its CPU oracle otherwise — a default
+    deployment on accelerated hardware must actually use the accelerator."""
+    import warnings
+
     from dfs_tpu.config import CDCParams
     from dfs_tpu.fragmenter.cdc_cpu import CpuCdcFragmenter
     from dfs_tpu.fragmenter.cdc_tpu import TpuCdcFragmenter
     from dfs_tpu.fragmenter.fixed import FixedFragmenter
 
+    if kind == "auto":
+        kind = "cdc-anchored-tpu" if tpu_available() else "cdc-anchored"
     if kind == "fixed":
         return FixedFragmenter(parts=fixed_parts)
     if kind in ("cdc-anchored", "cdc-anchored-tpu"):
@@ -125,5 +158,11 @@ def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5) -> Fragm
     if kind == "cdc":
         return CpuCdcFragmenter(params)
     if kind == "cdc-tpu":
+        warnings.warn(
+            "the v1 'cdc-tpu' fragmenter pulls the full candidate bitmap "
+            "to the host and measured ~300x slower than 'cdc-anchored-tpu' "
+            "on v5e (commit 40a6f77); it is kept as a byte-granular "
+            "compatibility path only",
+            DeprecationWarning, stacklevel=2)
         return TpuCdcFragmenter(params)
     raise ValueError(f"unknown fragmenter {kind!r}")

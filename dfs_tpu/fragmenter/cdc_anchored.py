@@ -31,8 +31,7 @@ from dfs_tpu.fragmenter.base import Fragmenter
 from dfs_tpu.meta.manifest import ChunkRef, Manifest
 from dfs_tpu.ops.cdc_anchored import (TILE_BYTES, AnchoredCdcParams,
                                       chunk_file_anchored_np, region_buffer,
-                                      region_chunks, region_collect,
-                                      region_dispatch)
+                                      region_collect, region_dispatch)
 from dfs_tpu.ops.cdc_v2 import file_id_from_digests
 
 _REGION_BYTES = 64 * 1024 * 1024
@@ -93,12 +92,15 @@ class AnchoredTpuFragmenter(_AnchoredBase):
 
     # -- pipelined region walk shared by chunk() and manifest_stream() ----
 
-    def _dispatch_window(self, arr: np.ndarray, base: int, n: int,
-                         start0) -> tuple:
+    def _dispatch_window(self, fetch, base: int, n: int, start0,
+                         final: bool) -> tuple:
         """device_put window [base, min(n, base+region_bytes)) and dispatch
         the fused chain; returns (base, out) with out all device arrays.
-        ``arr`` must hold absolute stream bytes [>= base-8, end).
-        Buffer shapes bucket to the next power of two (region_buffer), so a
+        ``fetch(off, ln)`` must return stream bytes as a u8 array for any
+        span inside [base-8, end). ``final`` must be passed explicitly —
+        inferring it from end == n would misfire mid-stream when the bytes
+        received so far happen to land exactly on a window end. Buffer
+        shapes bucket to the next power of two (region_buffer), so a
         multi-window walk compiles once for the full windows plus at most
         once for the shorter tail window."""
         import jax
@@ -107,14 +109,14 @@ class AnchoredTpuFragmenter(_AnchoredBase):
         lookback = np.zeros((8,), np.uint8)
         take = min(8, base)
         if take:
-            lookback[8 - take:] = arr[base - take:base]
+            lookback[8 - take:] = fetch(base - take, take)
         words = jax.device_put(region_buffer(
-            arr[base:end], lookback, self.params))
-        out = region_dispatch(words, end - base, start0, end == n,
+            fetch(base, end - base), lookback, self.params))
+        out = region_dispatch(words, end - base, start0, final,
                               self.params, lane_multiple=self.lane_multiple)
         return base, out
 
-    def _collect_window(self, base: int, out, arr: np.ndarray,
+    def _collect_window(self, base: int, out, fetch,
                         chunks: list[ChunkRef], store) -> int:
         """Pull one window's results, append absolute-offset ChunkRefs;
         returns the absolute consumed bound. Verifies span contiguity (the
@@ -130,7 +132,7 @@ class AnchoredTpuFragmenter(_AnchoredBase):
             c = ChunkRef(index=len(chunks), offset=off, length=ln, digest=dg)
             chunks.append(c)
             if store is not None:
-                store(dg, arr[off:off + ln].tobytes())
+                store(dg, fetch(off, ln).tobytes())
         return base + consumed
 
     def _walk(self, arr: np.ndarray, store=None) -> list[ChunkRef]:
@@ -147,23 +149,24 @@ class AnchoredTpuFragmenter(_AnchoredBase):
                           arr[c.offset:c.offset + c.length].tobytes())
             return out
 
+        fetch = lambda off, ln: arr[off:off + ln]       # noqa: E731
         chunks: list[ChunkRef] = []
         pending: list[tuple] = []      # [(base, device outputs)]
         start0 = 0                     # int for window 0, device scalar after
         base = 0
         while True:
             if len(pending) >= self.max_inflight:   # cap live windows
-                self._collect_window(*pending.pop(0), arr, chunks, store)
-            b, out = self._dispatch_window(arr, base, n, start0)
-            pending.append((b, out))
+                self._collect_window(*pending.pop(0), fetch, chunks, store)
             final = base + self.region_bytes >= n
+            b, out = self._dispatch_window(fetch, base, n, start0, final)
+            pending.append((b, out))
             if final:
                 break
             start0 = out[0] - self.stride   # device-resident carry
             base += self.stride
         bound = 0
         for b, out in pending:
-            bound = self._collect_window(b, out, arr, chunks, store)
+            bound = self._collect_window(b, out, fetch, chunks, store)
         if bound != n:
             raise AssertionError(f"anchored walk ended at {bound} != {n}")
         return chunks
@@ -172,52 +175,82 @@ class AnchoredTpuFragmenter(_AnchoredBase):
         return self._walk(_to_u8(data))
 
     def manifest_stream(self, blocks, name: str, store=None) -> Manifest:
-        """Bounded-memory streaming: buffer holds only the bytes past the
-        last emitted boundary (plus tile alignment + 8 lookback bytes);
-        full regions flush as the stream arrives. Output is identical to
-        chunk() on the concatenated stream by construction."""
+        """Bounded-memory PIPELINED streaming: same fixed-stride window
+        schedule and device-chained carry as chunk() (the two paths emit
+        identical chunks by construction), dispatching each full window as
+        soon as its bytes arrive while up to ``max_inflight`` windows
+        compute. The host buffer is trimmed to the oldest un-collected
+        window's base minus the 8-byte lookback, so peak memory is
+        ~(max_inflight + 1) windows regardless of stream length."""
         chunks: list[ChunkRef] = []
         buf = bytearray()
         buf_base = 0                   # absolute offset of buf[0]
-        bound = 0                      # absolute last emitted boundary
         total = 0                      # absolute bytes received
+        pending: list[tuple] = []
+        start0 = 0
+        base = 0
+        done = False
 
-        def run_region(final: bool) -> None:
-            nonlocal buf, buf_base, bound
-            base = (bound // TILE_BYTES) * TILE_BYTES
-            end = min(total, base + self.region_bytes)
-            arr = np.frombuffer(bytes(buf), dtype=np.uint8)
-            region = arr[base - buf_base:end - buf_base]
-            lb = np.zeros((8,), np.uint8)
-            take = min(8, base - buf_base)
-            if take:
-                lb[8 - take:] = arr[base - buf_base - take:base - buf_base]
-            spans, consumed = region_chunks(
-                region, lb, bound - base, final and end == total,
-                self.params, lane_multiple=self.lane_multiple)
-            for o, ln, dg in spans:
-                c = ChunkRef(index=len(chunks), offset=base + o, length=ln,
-                             digest=dg)
-                chunks.append(c)
-                if store is not None:
-                    store(dg, region[o:o + ln].tobytes())
-            if base + consumed <= bound and not (final and end == total):
-                raise AssertionError("anchored stream walk stalled")
-            bound = base + consumed
-            keep_from = max(buf_base,
-                            (bound // TILE_BYTES) * TILE_BYTES - 8)
+        def fetch(off: int, ln: int) -> np.ndarray:
+            if off < buf_base:
+                raise AssertionError(
+                    f"stream buffer trimmed past {off} (base {buf_base})")
+            return np.frombuffer(buf, np.uint8,
+                                 count=ln, offset=off - buf_base)
+
+        def trim() -> None:
+            nonlocal buf, buf_base
+            oldest = pending[0][0] if pending else base
+            keep_from = max(buf_base, oldest - 8)
             if keep_from > buf_base:
                 del buf[:keep_from - buf_base]
                 buf_base = keep_from
 
-        for b in blocks:
-            buf += b
-            total += len(b)
-            while total - bound >= self.region_bytes:
-                run_region(final=False)
-        while bound < total:
-            run_region(final=True)
+        def advance(n_known: int, final_ok: bool) -> None:
+            """Dispatch every window whose bytes are fully buffered."""
+            nonlocal base, start0, done
+            while not done:
+                full = base + self.region_bytes <= n_known
+                final = final_ok and base + self.region_bytes >= n_known
+                if not (full or final):
+                    return
+                if len(pending) >= self.max_inflight:
+                    self._collect_window(*pending.pop(0), fetch, chunks,
+                                         store)
+                b, out = self._dispatch_window(fetch, base, n_known, start0,
+                                               final)
+                pending.append((b, out))
+                trim()
+                if final:
+                    done = True
+                    return
+                start0 = out[0] - self.stride
+                base += self.stride
 
+        for blk in blocks:
+            buf += blk
+            total += len(blk)
+            advance(total, final_ok=False)
+        if total == 0:
+            return Manifest(file_id=file_id_from_digests([]), name=name,
+                            size=0, fragmenter=self.name, chunks=())
+        if total <= self.cpu_cutoff and not pending and base == 0:
+            # small streams take chunk()'s oracle fast path (identical
+            # output either way; this skips device dispatch entirely)
+            cl = self._walk(np.frombuffer(buf, np.uint8), store=store)
+            return Manifest(
+                file_id=file_id_from_digests([c.digest for c in cl]),
+                name=name, size=total, fragmenter=self.name,
+                chunks=tuple(cl))
+        advance(total, final_ok=True)
+        bound = 0
+        while pending:
+            bound = self._collect_window(*pending.pop(0), fetch, chunks,
+                                         store)
+            trim()
+        if bound != total:
+            raise AssertionError(
+                f"anchored stream ended at {bound} != {total}")
         return Manifest(
             file_id=file_id_from_digests([c.digest for c in chunks]),
             name=name, size=total, fragmenter=self.name,

@@ -185,14 +185,14 @@ class StorageNodeServer:
         # Group unique chunk payloads per target node.
         per_node: dict[int, list[tuple[str, bytes]]] = {}
         copies: dict[str, int] = {}
-        seen: set[str] = set()
+        payload_of: dict[str, bytes] = {}
         for c in manifest.chunks:
-            if c.digest in seen:
+            if c.digest in payload_of:
                 continue  # duplicate content within the file: place once
-            seen.add(c.digest)
             copies[c.digest] = 0
             # slice once; the same bytes object is shared across targets
             payload = data[c.offset:c.offset + c.length]
+            payload_of[c.digest] = payload
             for target in replica_set(c.digest, ids, rf):
                 if target == self.cfg.node_id:
                     if self.store.chunks.put(c.digest, payload, verify=False):
@@ -204,7 +204,7 @@ class StorageNodeServer:
                 else:
                     per_node.setdefault(target, []).append((c.digest, payload))
 
-        stats = {"bytes": len(data), "uniqueChunks": len(seen),
+        stats = {"bytes": len(data), "uniqueChunks": len(payload_of),
                  "transferredBytes": 0, "dedupSkippedBytes": 0}
 
         async def replicate(node_id: int,
@@ -249,15 +249,65 @@ class StorageNodeServer:
             await asyncio.gather(*(replicate(nid, w)
                                    for nid, w in per_node.items()))
 
+        # Sloppy-quorum fallback (hinted handoff): chunks still below
+        # quorum try the next nodes in their digest ring, so a dead
+        # canonical target costs availability only when fewer than
+        # ``write_quorum`` nodes in the WHOLE cluster are reachable. The
+        # reference aborts the entire upload on ANY dead peer
+        # (StorageNode.java:218-221); this keeps its >=2-copies durability
+        # without its write-all fragility. Handoff copies are queued for
+        # repair, which migrates them back to canonical placement.
+        # Effective quorum: write_quorum can't exceed the copies placement
+        # will ever make — rf (the policy) or the cluster size (a 1-node
+        # cluster's single copy IS every copy in the world). Without the
+        # clamp a legal `--nodes 1` deployment fails every upload.
+        quorum = min(self.cfg.write_quorum, rf, len(ids))
+        handoff: set[str] = set()
+        next_try = {d: rf for d in copies}           # ring index per digest
+        with span("upload.handoff", self.latency):
+            while True:
+                need = [d for d, n in copies.items() if n < quorum]
+                if not need:
+                    break
+                groups: dict[int, list[tuple[str, bytes]]] = {}
+                progress = False
+                for d in need:
+                    order = replica_set(d, ids, len(ids))
+                    if next_try[d] >= len(order):
+                        continue                     # cluster exhausted
+                    target = order[next_try[d]]
+                    next_try[d] += 1
+                    progress = True
+                    handoff.add(d)
+                    if target == self.cfg.node_id:
+                        if self.store.chunks.put(d, payload_of[d],
+                                                 verify=False):
+                            self.counters.inc("chunks_stored")
+                            self.counters.inc("bytes_stored",
+                                              len(payload_of[d]))
+                        copies[d] += 1   # local copy counts even on dedup
+                    else:
+                        groups.setdefault(target, []).append(
+                            (d, payload_of[d]))
+                if not progress:
+                    break
+                if groups:
+                    await asyncio.gather(*(replicate(nid, w)
+                                           for nid, w in groups.items()))
+
         # Write-quorum policy (vs reference write-all abort, :218-221).
-        failed = [d for d, n in copies.items() if n < self.cfg.write_quorum]
+        failed = [d for d, n in copies.items() if n < quorum]
         if failed:
             raise UploadError(
                 f"Replication failed: {len(failed)} chunks below quorum "
-                f"{self.cfg.write_quorum}")
+                f"{quorum}")
         for d, n in copies.items():
-            if n < rf:
+            if n < rf or d in handoff:
                 self.under_replicated.add(d)
+        stats["minCopies"] = min(copies.values(), default=rf)
+        stats["handoffChunks"] = len(handoff)
+        stats["degraded"] = bool(
+            handoff or any(n < rf for n in copies.values()))
 
         # Manifest-last ordering (SURVEY.md §5.4), then best-effort announce
         # (reference: announce failure only logged, StorageNode.java:338-346).

@@ -264,3 +264,87 @@ def shard_anchored_inputs(mesh: Mesh, words: np.ndarray, w_off: np.ndarray,
         jax.device_put(sh8, lane),
         jax.device_put(real_blocks, lane),
     )
+
+
+def anchored_sharded_parity_check(mesh: Mesh, n_devices: int) -> None:
+    """Run both sharded anchored passes on a tiny stream and assert parity
+    with the NumPy oracles — shared by the driver's multichip dryrun
+    (__graft_entry__) and the test suite so the two always validate the
+    same contract (pass-A tiles == first-anchor-per-tile oracle, pass-B
+    cutflags == per-segment selection, psum == population, reconstructed
+    spans == whole-stream chunk_spans_anchored_np)."""
+    from dfs_tpu.ops.cdc_anchored import (TILE_BYTES, AnchoredCdcParams,
+                                          chunk_spans_anchored_np,
+                                          kept_anchors_np, region_buffer,
+                                          select_segments)
+    from dfs_tpu.ops.cdc_v2 import BLOCK, AlignedCdcParams, candidates_np, \
+        select_cuts_blocks
+
+    params = AnchoredCdcParams(
+        chunk=AlignedCdcParams(min_blocks=2, avg_blocks=4, max_blocks=16,
+                               strip_blocks=64),        # 4 KiB lanes
+        seg_min=2048, seg_max=4096, seg_mask=2047)
+
+    m_local = 4 * TILE_BYTES // 4                       # 4 tiles per device
+    m_words = m_local * n_devices
+    n = m_words * 4
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, size=n, dtype=np.uint8)
+    words = np.asarray(region_buffer(data, np.zeros((8,), np.uint8), params,
+                                     m_words=m_words))
+
+    # ---- pass A sharded: tiles vs NumPy oracle ----
+    astep = make_anchored_anchor_step(mesh, params, m_local)
+    tiles = np.asarray(astep(shard_anchor_inputs(mesh, words, m_local)))
+    kept = kept_anchors_np(data, params)
+    expect_tiles = np.full((m_words * 4 // TILE_BYTES,), 2**30, np.int32)
+    for p in kept:                     # kept is first-per-tile already
+        expect_tiles[int(p) // TILE_BYTES] = int(p)
+    if not np.array_equal(tiles, expect_tiles):
+        raise AssertionError("sharded anchored pass A tile mismatch")
+
+    # ---- host segment selection (metadata-sized, shared with oracle) ----
+    bounds = select_segments(kept, n, params)
+    starts = np.concatenate([[0], bounds[:-1]])
+    seg_lens = bounds - starts
+    s_real = starts.shape[0]
+    s_pad = -(-s_real // n_devices) * n_devices
+    w_off = np.zeros((s_pad,), np.int32)
+    sh8 = np.zeros((s_pad,), np.uint32)
+    real_blocks = np.zeros((s_pad,), np.int32)
+    w_off[:s_real] = starts // 4 + 2
+    sh8[:s_real] = (starts % 4) * 8
+    real_blocks[:s_real] = -(-seg_lens // BLOCK)
+
+    # ---- pass B sharded: per-segment cutflags vs oracle ----
+    bstep = make_anchored_step(mesh, params)
+    cf, since, _states, n_chunks = bstep(*shard_anchored_inputs(
+        mesh, words, w_off, sh8, real_blocks))
+    cf = np.asarray(cf)
+    bps = params.chunk.strip_blocks
+    for i in range(s_real):
+        seg = data[starts[i]:bounds[i]]
+        nb = -(-seg.shape[0] // BLOCK)
+        pos = np.flatnonzero(candidates_np(seg, params.chunk))
+        cuts = select_cuts_blocks(pos, nb, params.chunk)
+        expect = np.zeros((bps,), np.int32)
+        expect[cuts - 1] = 1
+        if not np.array_equal(cf[:, i], expect):
+            raise AssertionError(
+                f"anchored sharded cutflag mismatch, segment {i}")
+    if int(n_chunks) != int(cf.sum()):
+        raise AssertionError("anchored psum chunk count mismatch")
+
+    # ---- end-to-end span parity vs the whole-stream oracle ----
+    spans = []
+    for i in range(s_real):
+        ln = int(seg_lens[i])
+        cuts = np.flatnonzero(cf[:, i]) + 1
+        prev = 0
+        for c in cuts.tolist():
+            end = min(c * BLOCK, ln)
+            spans.append((int(starts[i]) + prev * BLOCK,
+                          end - prev * BLOCK))
+            prev = c
+    if spans != chunk_spans_anchored_np(data, params):
+        raise AssertionError("anchored sharded spans != oracle spans")

@@ -178,8 +178,60 @@ def test_streaming_matches_chunk_any_blocking():
         assert b"".join(stored[c.digest] for c in got.chunks) == data
 
 
+def test_streaming_block_lands_exactly_on_window_end():
+    """A block boundary that lands exactly on a window end mid-stream must
+    NOT finalize the walk early (the tail segment carries on): regression
+    for inferring `final` from end == bytes-received-so-far."""
+    frag = anchored_frag()             # region_bytes=16384
+    data = corpus(50000, seed=44).tobytes()
+    # first block = exactly one region; the dispatcher sees n_known ==
+    # base + region_bytes with more data still to come
+    blocks = [data[:16384], data[16384:]]
+    got = frag.manifest_stream(blocks, name="f").chunks
+    want = anchored_frag().chunk(data)
+    assert list(got) == want
+
+
 def test_factory_anchored_kinds():
     from dfs_tpu.fragmenter.base import get_fragmenter
 
     assert get_fragmenter("cdc-anchored").name == "cdc-anchored"
     assert get_fragmenter("cdc-anchored-tpu").name == "cdc-anchored-tpu"
+
+
+def test_factory_auto_resolves_by_device(monkeypatch):
+    """'auto' (the serve default) must pick the anchored TPU pipeline on
+    TPU hosts and the anchored CPU oracle elsewhere."""
+    import dfs_tpu.fragmenter.base as base
+
+    monkeypatch.setattr(base, "tpu_available", lambda: True)
+    assert base.get_fragmenter("auto").name == "cdc-anchored-tpu"
+    monkeypatch.setattr(base, "tpu_available", lambda: False)
+    assert base.get_fragmenter("auto").name == "cdc-anchored"
+
+
+def test_factory_auto_honors_chunk_params(monkeypatch):
+    """Operator chunk sizing flows through auto into the nested grid
+    (ADVICE round 1: the anchored branch silently dropped CDCParams)."""
+    import dfs_tpu.fragmenter.base as base
+    from dfs_tpu.config import CDCParams
+
+    monkeypatch.setattr(base, "tpu_available", lambda: False)
+    f = base.get_fragmenter(
+        "auto", cdc_params=CDCParams(min_size=1024, avg_size=4096,
+                                     max_size=32768))
+    assert f.params.chunk.min_blocks == 16
+    assert f.params.chunk.avg_blocks == 64
+    assert f.params.chunk.max_blocks == 512
+    assert f.params.seg_max == f.params.chunk.strip_blocks * 64
+
+
+def test_cdc_tpu_v1_deprecation_warning():
+    import warnings
+
+    from dfs_tpu.fragmenter.base import get_fragmenter
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        get_fragmenter("cdc-tpu")
+    assert any(issubclass(x.category, DeprecationWarning) for x in w)

@@ -140,6 +140,62 @@ def test_upload_with_node_down_write_quorum(tmp_path, rng):
     asyncio.run(run())
 
 
+def test_upload_all_peers_down_fails_at_default_quorum(tmp_path, rng):
+    """With every peer down, the default write_quorum=2 must refuse the
+    upload — a 201 with exactly one copy in the world is weaker durability
+    than the reference's write-all (VERDICT r1 weak §6)."""
+    data = rng.integers(0, 256, size=20_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path, ids={1},
+                                  retries=1, connect_timeout_s=0.2)
+        try:
+            assert nodes[1].cfg.write_quorum == 2   # the default
+            with pytest.raises(UploadError):
+                await nodes[1].upload(data, "doomed.bin")
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_upload_handoff_keeps_quorum_with_target_down(tmp_path, rng):
+    """A dead canonical target must not fail the upload OR degrade to one
+    copy: sloppy-quorum handoff places the second copy on the next ring
+    node, the response reports it, and repair migrates it back."""
+    data = rng.integers(0, 256, size=60_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(4)
+        nodes = await start_nodes(cluster, tmp_path, ids={1, 2, 3},
+                                  retries=1, connect_timeout_s=0.3)
+        try:
+            manifest, stats = await nodes[1].upload(data, "handoff.bin")
+            assert stats["minCopies"] >= 2          # quorum held
+            # every unique chunk has >= 2 live copies among nodes 1..3
+            alive = [nodes[i] for i in (1, 2, 3)]
+            for c in manifest.chunks:
+                have = sum(n.store.chunks.has(c.digest) for n in alive)
+                assert have >= 2, f"chunk {c.digest[:8]} has {have} copies"
+            if stats["handoffChunks"]:
+                assert stats["degraded"]
+                # node 4 returns; repair restores canonical placement
+                nodes.update(await start_nodes(
+                    cluster, tmp_path, ids={4},
+                    retries=1, connect_timeout_s=0.3))
+                await nodes[1].repair_once()
+                from dfs_tpu.node.placement import replica_set
+                ids = cluster.sorted_ids()
+                for c in manifest.chunks:
+                    for t in replica_set(c.digest, ids, 2):
+                        assert nodes[t].store.chunks.has(c.digest)
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
 def test_upload_fails_below_quorum(tmp_path, rng):
     """With every replica target down and quorum unreachable, upload must
     fail loudly (HTTP 500 'Replication failed' at the API layer)."""

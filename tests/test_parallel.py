@@ -45,70 +45,14 @@ def test_sharded_step_matches_single_device(rng):
         hashlib.sha256(m).hexdigest() for m in msgs]
 
 
-def test_anchored_sharded_step_matches_oracle(rng):
+def test_anchored_sharded_step_matches_oracle():
     """Flagship v3 sharded: pass A (stream-sharded anchors, baked 8-byte
     halo) + pass B (segment lanes sharded) must reproduce the whole-stream
-    NumPy oracle spans exactly."""
-    from dfs_tpu.ops.cdc_anchored import (TILE_BYTES, AnchoredCdcParams,
-                                          chunk_spans_anchored_np,
-                                          kept_anchors_np, region_buffer,
-                                          select_segments)
-    from dfs_tpu.ops.cdc_v2 import BLOCK, AlignedCdcParams
-    from dfs_tpu.parallel.sharded_cdc import (make_anchored_anchor_step,
-                                              make_anchored_step,
-                                              shard_anchor_inputs,
-                                              shard_anchored_inputs)
+    NumPy oracle spans exactly. Shares the parity harness with the
+    driver's multichip dryrun so both validate one contract."""
+    from dfs_tpu.parallel.sharded_cdc import anchored_sharded_parity_check
 
-    params = AnchoredCdcParams(
-        chunk=AlignedCdcParams(min_blocks=2, avg_blocks=4, max_blocks=16,
-                               strip_blocks=64),
-        seg_min=2048, seg_max=4096, seg_mask=2047)
-    mesh = make_mesh(8)
-    n_dev = 8
-    m_local = 4 * TILE_BYTES // 4
-    m_words = m_local * n_dev
-    n = m_words * 4
-    data = rng.integers(0, 256, size=n, dtype=np.uint8)
-    words = np.asarray(region_buffer(data, np.zeros((8,), np.uint8),
-                                     params, m_words=m_words))
-
-    astep = make_anchored_anchor_step(mesh, params, m_local)
-    tiles = np.asarray(astep(shard_anchor_inputs(mesh, words, m_local)))
-    kept = kept_anchors_np(data, params)
-    expect = np.full((m_words * 4 // TILE_BYTES,), 2**30, np.int32)
-    for p in kept:
-        expect[int(p) // TILE_BYTES] = int(p)  # kept is first-per-tile
-    np.testing.assert_array_equal(tiles, expect)
-
-    bounds = select_segments(kept, n, params)
-    starts = np.concatenate([[0], bounds[:-1]])
-    seg_lens = bounds - starts
-    s_real = starts.shape[0]
-    s_pad = -(-s_real // n_dev) * n_dev
-    w_off = np.zeros((s_pad,), np.int32)
-    sh8 = np.zeros((s_pad,), np.uint32)
-    real_blocks = np.zeros((s_pad,), np.int32)
-    w_off[:s_real] = starts // 4 + 2
-    sh8[:s_real] = (starts % 4) * 8
-    real_blocks[:s_real] = -(-seg_lens // BLOCK)
-
-    bstep = make_anchored_step(mesh, params)
-    cf, since, states, n_chunks = bstep(*shard_anchored_inputs(
-        mesh, words, w_off, sh8, real_blocks))
-    cf = np.asarray(cf)
-    assert int(n_chunks) == int(cf.sum())
-
-    spans = []
-    for i in range(s_real):
-        ln = int(seg_lens[i])
-        cuts = np.flatnonzero(cf[:, i]) + 1
-        prev = 0
-        for c in cuts.tolist():
-            end = min(c * BLOCK, ln)
-            spans.append((int(starts[i]) + prev * BLOCK,
-                          end - prev * BLOCK))
-            prev = c
-    assert spans == chunk_spans_anchored_np(data, params)
+    anchored_sharded_parity_check(make_mesh(8), 8)
 
 
 def test_sharded_step_dp_only(rng):

@@ -42,6 +42,7 @@ def cmd_serve(args) -> int:
     cfg = NodeConfig(
         node_id=args.node_id, cluster=cluster,
         data_root=Path(args.data_root), fragmenter=args.fragmenter,
+        sidecar_port=args.sidecar_port,
         cdc=CDCParams(min_size=args.min_chunk, avg_size=args.avg_chunk,
                       max_size=args.max_chunk))
 
@@ -65,6 +66,27 @@ def cmd_serve(args) -> int:
         asyncio.run(run())
     except KeyboardInterrupt:
         pass
+    return 0
+
+
+def cmd_sidecar(args) -> int:
+    import time
+
+    from dfs_tpu.sidecar.service import SidecarServer
+
+    srv = SidecarServer(
+        port=args.sidecar_port, fragmenter=args.fragmenter,
+        cdc_params=CDCParams(min_size=args.min_chunk,
+                             avg_size=args.avg_chunk,
+                             max_size=args.max_chunk))
+    srv.start()
+    print(f"sidecar listening on 127.0.0.1:{srv.port} "
+          f"(fragmenter={srv.fragmenter.name})", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
     return 0
 
 
@@ -207,7 +229,21 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--avg-chunk", type=int, default=8192)
     serve.add_argument("--max-chunk", type=int, default=65536)
     serve.add_argument("--repair-interval", type=float, default=30.0)
+    serve.add_argument("--sidecar-port", type=int, default=None,
+                       help="delegate chunk+hash to a running sidecar "
+                            "process (overrides --fragmenter)")
     serve.set_defaults(fn=cmd_serve)
+
+    sc = sub.add_parser("sidecar", help="run the chunk+hash sidecar service")
+    sc.add_argument("--sidecar-port", type=int, default=50151)
+    sc.add_argument(
+        "--fragmenter", default="auto",
+        choices=["auto", "fixed", "cdc", "cdc-tpu", "cdc-aligned",
+                 "cdc-aligned-tpu", "cdc-anchored", "cdc-anchored-tpu"])
+    sc.add_argument("--min-chunk", type=int, default=2048)
+    sc.add_argument("--avg-chunk", type=int, default=8192)
+    sc.add_argument("--max-chunk", type=int, default=65536)
+    sc.set_defaults(fn=cmd_sidecar)
 
     sub.add_parser("status").set_defaults(fn=cmd_status)
     sub.add_parser("list").set_defaults(fn=cmd_list)

@@ -100,8 +100,14 @@ class InternalClient:
             peer, {"op": "store_chunks", "fileId": file_id, "chunks": table}, body)
         return list(resp.get("digests", []))
 
-    async def announce(self, peer: PeerAddr, manifest_json: str) -> None:
-        await self.call(peer, {"op": "announce", "manifest": manifest_json})
+    async def announce(self, peer: PeerAddr, manifest_json: str,
+                       fresh: bool = False) -> None:
+        """``fresh=True`` marks an announce coming straight from an upload
+        in progress — receivers clear any tombstone for the file id (a new
+        upload resurrects deleted content on purpose). Replayed/stale
+        announces leave it unset and bounce off tombstones."""
+        await self.call(peer, {"op": "announce", "manifest": manifest_json,
+                               "fresh": fresh})
 
     async def get_chunk(self, peer: PeerAddr, digest: str) -> bytes:
         _, body = await self.call(peer, {"op": "get_chunk", "digest": digest})

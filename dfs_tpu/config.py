@@ -149,6 +149,8 @@ class NodeConfig:
                                    # present) | "fixed" | "cdc" | "cdc-tpu"
                                    # | "cdc-aligned[-tpu]"
                                    # | "cdc-anchored[-tpu]"
+    sidecar_port: int | None = None  # delegate chunk+hash to a sidecar
+                                     # process (overrides `fragmenter`)
     cdc: CDCParams = dataclasses.field(default_factory=CDCParams)
     fixed_parts: int = 5           # FixedFragmenter part count (reference: TOTAL_NODES=5)
     connect_timeout_s: float = 2.0  # reference: 2000 ms, StorageNode.java:229-230

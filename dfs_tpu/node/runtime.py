@@ -35,7 +35,8 @@ from dfs_tpu.meta.manifest import Manifest
 from dfs_tpu.node.health import HealthMonitor
 from dfs_tpu.node.placement import replica_set
 from dfs_tpu.store.cas import NodeStore
-from dfs_tpu.utils.hashing import sha256_hex, sha256_many_hex
+from dfs_tpu.utils.hashing import (is_hex_digest, sha256_hex,
+                                   sha256_many_hex)
 from dfs_tpu.utils.logging import Counters, get_logger
 from dfs_tpu.utils.trace import LatencyRecorder, span
 
@@ -57,8 +58,16 @@ class StorageNodeServer:
     def __init__(self, cfg: NodeConfig) -> None:
         self.cfg = cfg
         self.store = NodeStore(cfg.data_root, cfg.node_id)
-        self.fragmenter = get_fragmenter(
-            cfg.fragmenter, cdc_params=cfg.cdc, fixed_parts=cfg.fixed_parts)
+        if cfg.sidecar_port:
+            # delegate chunk+hash to a sidecar process (north-star shape:
+            # device init/compiles never block the serving loop)
+            from dfs_tpu.sidecar.service import SidecarFragmenter
+
+            self.fragmenter = SidecarFragmenter(cfg.sidecar_port)
+        else:
+            self.fragmenter = get_fragmenter(
+                cfg.fragmenter, cdc_params=cfg.cdc,
+                fixed_parts=cfg.fixed_parts)
         self.client = InternalClient(cfg.connect_timeout_s,
                                      cfg.request_timeout_s, cfg.retries)
         self.health = HealthMonitor(cfg.cluster, cfg.node_id, self.client,
@@ -141,9 +150,16 @@ class StorageNodeServer:
             return {"ok": True, "have": have}, b""
         if op == "announce":
             m = Manifest.from_json(header["manifest"])
-            self.store.manifests.save(m)
-            self.counters.inc("manifests_announced")
+            if header.get("fresh"):
+                self.store.manifests.clear_tombstone(m.file_id)
+            if self.store.manifests.save(m):
+                self.counters.inc("manifests_announced")
+            else:
+                self.counters.inc("announce_rejected_tombstoned")
             return {"ok": True}, b""
+        if op == "tombstones":
+            return {"ok": True,
+                    "ids": self.store.manifests.tombstones()}, b""
         if op == "get_chunk":
             data = self.store.chunks.get(header["digest"])
             if data is None:
@@ -311,11 +327,17 @@ class StorageNodeServer:
 
         # Manifest-last ordering (SURVEY.md §5.4), then best-effort announce
         # (reference: announce failure only logged, StorageNode.java:338-346).
-        self.store.manifests.save(manifest)
+        # A fresh upload clears tombstones (locally and via fresh=True at
+        # peers): re-uploading deleted content must resurrect the
+        # content-derived file id, not leave it permanently undownloadable.
+        self.store.manifests.clear_tombstone(manifest.file_id)
+        if not self.store.manifests.save(manifest):
+            raise UploadError("manifest save refused (tombstone race)")
 
         async def announce(peer) -> None:
             try:
-                await self.client.announce(peer, manifest.to_json())
+                await self.client.announce(peer, manifest.to_json(),
+                                           fresh=True)
             except RpcError as e:
                 self.log.warning("announce to node %d failed: %s",
                                  peer.node_id, e)
@@ -361,6 +383,11 @@ class StorageNodeServer:
 
     async def download(self, file_id: str) -> tuple[Manifest, bytes]:
         manifest = self.store.manifests.load(file_id)
+        if manifest is None and self.store.manifests.is_tombstoned(file_id):
+            # deleted — without this gate the peer fallback below would
+            # happily serve the file from a node that slept through the
+            # delete (the exact resurrection tombstones exist to prevent)
+            raise NotFoundError(file_id)
         if manifest is None:
             # Manifest fallback from peers — fixes the reference's silent
             # manifest loss on nodes that were down during announce (§5.3).
@@ -407,7 +434,7 @@ class StorageNodeServer:
     # ------------------------------------------------------------------ #
 
     async def delete(self, file_id: str) -> bool:
-        found = self.store.manifests.delete(file_id)
+        found = self.store.manifests.delete(file_id)   # tombstone persists
         self.store.gc()
 
         async def forget(peer) -> None:
@@ -416,14 +443,48 @@ class StorageNodeServer:
             except RpcError:
                 pass
 
-        # Best-effort cluster-wide delete via announce of tombstone op.
+        # Best-effort immediate propagation; a node that is down right now
+        # converges later via tombstone anti-entropy in repair_once.
         await asyncio.gather(*(forget(p) for p in self._peers()))
         return found
+
+    async def _tombstone_antientropy(self) -> int:
+        """Pull peers' tombstones and apply any we don't know: a node that
+        slept through a delete learns of it here BEFORE re-replicating,
+        so its stale manifest can neither serve the file nor resurrect
+        its chunks onto peers. Returns #tombstones applied."""
+        known = set(self.store.manifests.tombstones())
+        applied = 0
+        for peer in self._peers():
+            if not self.health.is_alive(peer.node_id):
+                continue
+            try:
+                resp, _ = await self.client.call(
+                    peer, {"op": "tombstones"}, retries=1)
+            except RpcError:
+                continue
+            for fid in resp.get("ids", []):
+                # validate before applying: one malformed id from a skewed
+                # peer raising ValueError here would abort repair for every
+                # cycle and silently stop the cluster converging
+                if fid not in known and is_hex_digest(fid):
+                    self.store.manifests.delete(fid)   # writes tombstone
+                    known.add(fid)
+                    applied += 1
+        if applied:
+            self.store.gc()
+            self.log.info("anti-entropy: applied %d tombstones", applied)
+        return applied
 
     async def repair_once(self) -> int:
         """Re-replicate chunks below replication factor. Walks every local
         manifest; for chunks whose replica set includes peers missing the
-        bytes, pushes from a local or remote copy. Returns #chunks repaired."""
+        bytes, pushes from a local or remote copy. Returns #chunks repaired.
+
+        Tombstone anti-entropy runs FIRST: repairing from a manifest whose
+        file was deleted cluster-wide while this node slept would push the
+        deleted chunks back onto peers."""
+        await self._tombstone_antientropy()
         ids = self.cfg.cluster.sorted_ids()
         rf = self.cfg.cluster.replication_factor
         need: dict[int, list[tuple[str, int]]] = {}

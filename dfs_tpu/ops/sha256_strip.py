@@ -1,8 +1,9 @@
 """Strip-scan SHA-256: hash every chunk of a stream in one Pallas pass.
 
-The batched-message kernel (ops.sha256_pallas) needs each message gathered
-into its own row — and arbitrary-offset gathers measured ~0.6 s per 32 MiB
-on v5e, two orders slower than the hash itself. This kernel removes the
+A batched-message kernel (one message per lane row; built and discarded in
+round 1) needs each message gathered into its own row — and
+arbitrary-offset gathers measured ~0.6 s per 32 MiB on v5e, two orders
+slower than the hash itself. This kernel removes the
 gather: the stream stays in its strip-transposed resident layout
 (ops.cdc_v2.host_to_strips) and *chunk chaining follows the stream order*.
 

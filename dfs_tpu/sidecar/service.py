@@ -12,13 +12,21 @@ serialization: the environment ships grpcio but not grpc_tools/protoc-gen-py,
 and the payloads are length-delimited binary anyway (protobuf would Base64
 nothing, buy nothing). Methods (all under service ``dfs.Sidecar``):
 
-- ``ChunkHash``  unary-unary. Request: raw file bytes. Response: JSON header
-  (chunk table: offset/length/digest + params echo) — the exact information
-  the node runtime needs to build a Manifest.
+- ``ChunkHashStream`` **stream-unary — the production path**. Request: a
+  stream of raw byte blocks (any blocking; 4 MiB is typical). Response:
+  JSON chunk table. No payload ceiling: blocks feed the fragmenter's
+  bounded-memory pipelined streaming walk (fragmenter/cdc_anchored.py), so
+  a multi-GiB upload holds ~(max_inflight+1) regions in memory, never the
+  whole stream.
+- ``ChunkHash``  unary-unary compatibility path (whole payload in one
+  message, 1 GiB gRPC message cap applies).
 - ``Health``     unary-unary. Request: empty. Response: JSON status.
 
-The sidecar accepts a ``fragmenter`` name at startup ("cdc" CPU NumPy or
-"cdc-tpu" JAX/TPU) — the node runtime's plugin choice, reference §2.3 analog.
+The sidecar accepts a ``fragmenter`` name at startup — default ``auto``
+(the anchored flagship: TPU device path when a TPU is present, CPU oracle
+otherwise, fragmenter/base.py). ``SidecarFragmenter`` is the node-side
+adapter: a drop-in Fragmenter that delegates chunk+hash to a sidecar
+process (NodeConfig.sidecar_port wires it into the node runtime).
 """
 
 from __future__ import annotations
@@ -28,7 +36,10 @@ from concurrent import futures
 
 import grpc
 
+from dfs_tpu.fragmenter.base import Fragmenter
+
 _SERVICE = "dfs.Sidecar"
+STREAM_BLOCK = 4 * 1024 * 1024
 
 
 def _identity(x: bytes) -> bytes:
@@ -36,7 +47,7 @@ def _identity(x: bytes) -> bytes:
 
 
 class SidecarServer:
-    def __init__(self, port: int = 0, fragmenter: str = "cdc",
+    def __init__(self, port: int = 0, fragmenter: str = "auto",
                  cdc_params=None, max_workers: int = 4) -> None:
         from dfs_tpu.fragmenter.base import get_fragmenter
 
@@ -48,16 +59,30 @@ class SidecarServer:
         self._server.add_generic_rpc_handlers((self._handlers(),))
         self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
 
+    def _chunk_table(self, chunks, size: int) -> bytes:
+        from dfs_tpu.ops.cdc_v2 import file_id_from_digests
+
+        return json.dumps({
+            "fragmenter": self.fragmenter.name,
+            # digest-derived, NOT sha256(payload): re-hashing the whole
+            # payload to label the response would double the hash work of
+            # the very service whose job is fast hashing
+            "fileId": file_id_from_digests([c.digest for c in chunks]),
+            "size": size,
+            "chunks": [{"index": c.index, "offset": c.offset,
+                        "length": c.length, "digest": c.digest}
+                       for c in chunks],
+        }).encode()
+
     def _handlers(self) -> grpc.GenericRpcHandler:
         def chunk_hash(request: bytes, ctx) -> bytes:
-            chunks = self.fragmenter.chunk(request)
-            return json.dumps({
-                "fragmenter": self.fragmenter.name,
-                "size": len(request),
-                "chunks": [{"index": c.index, "offset": c.offset,
-                            "length": c.length, "digest": c.digest}
-                           for c in chunks],
-            }).encode()
+            return self._chunk_table(self.fragmenter.chunk(request),
+                                     len(request))
+
+        def chunk_hash_stream(request_iterator, ctx) -> bytes:
+            m = self.fragmenter.manifest_stream(request_iterator,
+                                                name="stream")
+            return self._chunk_table(list(m.chunks), m.size)
 
         def health(request: bytes, ctx) -> bytes:
             return json.dumps({"ok": True,
@@ -67,6 +92,10 @@ class SidecarServer:
             f"/{_SERVICE}/ChunkHash": grpc.unary_unary_rpc_method_handler(
                 chunk_hash, request_deserializer=_identity,
                 response_serializer=_identity),
+            f"/{_SERVICE}/ChunkHashStream":
+                grpc.stream_unary_rpc_method_handler(
+                    chunk_hash_stream, request_deserializer=_identity,
+                    response_serializer=_identity),
             f"/{_SERVICE}/Health": grpc.unary_unary_rpc_method_handler(
                 health, request_deserializer=_identity,
                 response_serializer=_identity),
@@ -86,7 +115,16 @@ class SidecarServer:
 
 
 class SidecarClient:
-    def __init__(self, port: int, host: str = "127.0.0.1") -> None:
+    """Deadlines are mandatory: the sidecar's fragmenter can wedge in
+    device init (the stale-tunnel JAX hang tpu_available() guards
+    against), and an un-deadlined blocking call from the node would freeze
+    its entire event loop."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 timeout_s: float = 600.0,
+                 health_timeout_s: float = 30.0) -> None:
+        self.timeout_s = timeout_s
+        self.health_timeout_s = health_timeout_s
         self._channel = grpc.insecure_channel(
             f"{host}:{port}",
             options=[("grpc.max_receive_message_length", 1 << 30),
@@ -94,15 +132,67 @@ class SidecarClient:
         self._chunk_hash = self._channel.unary_unary(
             f"/{_SERVICE}/ChunkHash", request_serializer=_identity,
             response_deserializer=_identity)
+        self._chunk_hash_stream = self._channel.stream_unary(
+            f"/{_SERVICE}/ChunkHashStream", request_serializer=_identity,
+            response_deserializer=_identity)
         self._health = self._channel.unary_unary(
             f"/{_SERVICE}/Health", request_serializer=_identity,
             response_deserializer=_identity)
 
     def chunk_hash(self, data: bytes) -> dict:
-        return json.loads(self._chunk_hash(data))
+        return json.loads(self._chunk_hash(data, timeout=self.timeout_s))
+
+    def chunk_hash_stream(self, blocks) -> dict:
+        """Stream byte blocks (any iterable of bytes) — no size ceiling."""
+        return json.loads(self._chunk_hash_stream(
+            iter(blocks), timeout=self.timeout_s))
 
     def health(self) -> dict:
-        return json.loads(self._health(b""))
+        return json.loads(self._health(b"", timeout=self.health_timeout_s))
 
     def close(self) -> None:
         self._channel.close()
+
+
+class SidecarFragmenter(Fragmenter):
+    """Drop-in Fragmenter that delegates chunk+hash to a sidecar process.
+
+    Keeps device init, XLA compiles, and the GIL-heavy hashing out of the
+    node's serving process — the north-star deployment shape ("the
+    StorageNode calls the TPU backend over a local gRPC sidecar"). Streams
+    in STREAM_BLOCK pieces, so payload size is unbounded on this side too.
+    manifest()/the store-callback streaming branch come from the base
+    class (the node runtime passes file_id explicitly, so no extra hashing
+    happens on the node path).
+    """
+
+    def __init__(self, port: int, host: str = "127.0.0.1") -> None:
+        self.client = SidecarClient(port, host=host)
+        self.name = f"sidecar:{self.client.health()['fragmenter']}"
+
+    def _refs(self, resp: dict):
+        from dfs_tpu.meta.manifest import ChunkRef
+
+        return tuple(ChunkRef(index=c["index"], offset=c["offset"],
+                              length=c["length"], digest=c["digest"])
+                     for c in resp["chunks"])
+
+    def chunk(self, data: bytes):
+        blocks = (data[i:i + STREAM_BLOCK]
+                  for i in range(0, len(data), STREAM_BLOCK))
+        return list(self._refs(self.client.chunk_hash_stream(blocks)))
+
+    def manifest_stream(self, blocks, name: str, store=None):
+        from dfs_tpu.meta.manifest import Manifest
+
+        if store is not None:
+            # store callbacks need the chunk bytes — the base fallback
+            # materializes; the node runtime never passes store
+            return super().manifest_stream(blocks, name=name, store=store)
+        resp = self.client.chunk_hash_stream(blocks)
+        return Manifest(file_id=resp["fileId"], name=name,
+                        size=resp["size"], fragmenter=self.name,
+                        chunks=self._refs(resp))
+
+    def close(self) -> None:
+        self.client.close()

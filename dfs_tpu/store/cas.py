@@ -108,8 +108,38 @@ class ManifestStore:
             raise ValueError(f"bad file_id {file_id!r}")
         return self.root / f"{file_id}.json"
 
-    def save(self, m: Manifest) -> None:
+    def _tomb_path(self, file_id: str) -> Path:
+        if not is_hex_digest(file_id):
+            raise ValueError(f"bad file_id {file_id!r}")
+        return self.root / f"{file_id}.tomb"
+
+    def is_tombstoned(self, file_id: str) -> bool:
+        return self._tomb_path(file_id).exists()
+
+    def clear_tombstone(self, file_id: str) -> None:
+        """A fresh upload of previously-deleted content resurrects the
+        file id intentionally; without this, a content-derived file_id
+        would be permanently unuploadable after one delete."""
+        self._tomb_path(file_id).unlink(missing_ok=True)
+
+    def tombstones(self) -> list[str]:
+        """File ids known deleted (hex-validated — a stray file in the
+        manifests dir must not poison peers' anti-entropy). Tombstones
+        persist (and replicate via repair anti-entropy) so a node that
+        slept through a delete cannot resurrect the file from its stale
+        manifest — the reference's announce-to-all model has exactly that
+        hole for *creates* already (SURVEY.md §3.4: best-effort, no
+        anti-entropy) and no delete at all (§2.5(5))."""
+        return sorted(p.stem for p in self.root.glob("*.tomb")
+                      if is_hex_digest(p.stem))
+
+    def save(self, m: Manifest) -> bool:
+        """Persist a manifest; refused (False) when the file is
+        tombstoned, so late announces cannot resurrect a deleted file."""
+        if self.is_tombstoned(m.file_id):
+            return False
         _atomic_write(self._path(m.file_id), m.to_json().encode())
+        return True
 
     def load(self, file_id: str) -> Manifest | None:
         try:
@@ -128,7 +158,11 @@ class ManifestStore:
                 continue  # skip corrupt manifest rather than failing the listing
         return out
 
-    def delete(self, file_id: str) -> bool:
+    def delete(self, file_id: str, tombstone: bool = True) -> bool:
+        """Remove a manifest; by default leaves a persistent tombstone
+        (written first — crash between the two steps errs toward delete)."""
+        if tombstone:
+            _atomic_write(self._tomb_path(file_id), b"{}")
         try:
             self._path(file_id).unlink()
             return True

@@ -280,6 +280,105 @@ def test_http_api_roundtrip(tmp_path, rng):
     asyncio.run(run())
 
 
+def test_delete_survives_node_downtime(tmp_path, rng):
+    """Delete while one node is down; when it returns, anti-entropy (run
+    before re-replication in repair_once) applies the tombstone: the file
+    stays deleted cluster-wide, its chunks get GC'd everywhere, a late
+    announce cannot resurrect it (VERDICT r1 weak §8)."""
+    data = rng.integers(0, 256, size=60_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path,
+                                  retries=1, connect_timeout_s=0.3)
+        try:
+            manifest, _ = await nodes[1].upload(data, "doomed.bin")
+            fid = manifest.file_id
+            # node 3 sleeps through the delete (its disk state persists)
+            await nodes.pop(3).stop()
+            assert await nodes[1].delete(fid)
+            for i in (1, 2):
+                assert nodes[i].store.manifests.load(fid) is None
+                assert nodes[i].store.manifests.is_tombstoned(fid)
+
+            # node 3 returns with the stale manifest + chunks on disk
+            nodes.update(await start_nodes(cluster, tmp_path, ids={3},
+                                           retries=1, connect_timeout_s=0.3))
+            assert nodes[3].store.manifests.load(fid) is not None
+
+            # its own repair applies the tombstone BEFORE re-replicating
+            await nodes[3].repair_once()
+            assert nodes[3].store.manifests.load(fid) is None
+            assert nodes[3].store.manifests.is_tombstoned(fid)
+            for n in nodes.values():
+                for c in manifest.chunks:
+                    assert not n.store.chunks.has(c.digest), \
+                        f"chunk {c.digest[:8]} survived on node"
+
+            # a late announce of the stale manifest must be refused
+            await nodes[3].client.announce(cluster.peer(1),
+                                           manifest.to_json())
+            assert nodes[1].store.manifests.load(fid) is None
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_reupload_after_delete_resurrects(tmp_path, rng):
+    """file_id is content-derived, so a fresh upload of deleted content
+    must clear tombstones cluster-wide and be downloadable again — not
+    silently succeed while every announce bounces off the tombstone."""
+    data = rng.integers(0, 256, size=40_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path,
+                                  retries=1, connect_timeout_s=0.3)
+        try:
+            m1, _ = await nodes[1].upload(data, "phoenix.bin")
+            assert await nodes[1].delete(m1.file_id)
+            for n in nodes.values():
+                assert n.store.manifests.is_tombstoned(m1.file_id)
+            m2, _ = await nodes[2].upload(data, "phoenix.bin")
+            assert m2.file_id == m1.file_id
+            for n in nodes.values():
+                assert not n.store.manifests.is_tombstoned(m2.file_id)
+                assert n.store.manifests.load(m2.file_id) is not None
+            _, got = await nodes[3].download(m2.file_id)
+            assert got == data
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_download_tombstoned_rejected_despite_stale_peer(tmp_path, rng):
+    """A node that knows the file is deleted must 404 even while a stale
+    peer still has the manifest + chunks (no resurrection via the
+    peer-manifest download fallback)."""
+    data = rng.integers(0, 256, size=40_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path,
+                                  retries=1, connect_timeout_s=0.3)
+        try:
+            m, _ = await nodes[1].upload(data, "ghost.bin")
+            await nodes.pop(3).stop()               # sleeps through delete
+            assert await nodes[1].delete(m.file_id)
+            nodes.update(await start_nodes(cluster, tmp_path, ids={3},
+                                           retries=1, connect_timeout_s=0.3))
+            # node 3 still has manifest + chunks; node 1 must still 404
+            assert nodes[3].store.manifests.load(m.file_id) is not None
+            with pytest.raises(NotFoundError):
+                await nodes[1].download(m.file_id)
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
 def test_corrupt_chunk_detected(tmp_path, rng):
     """Flip bytes in a stored chunk on every replica → download must fail
     with integrity error, not return corrupt data (whole-file gate is the

@@ -58,14 +58,35 @@ class _AnchoredBase(Fragmenter):
 
 
 class AnchoredCpuFragmenter(_AnchoredBase):
-    """NumPy oracle as the production CPU path."""
+    """Production CPU path: the C++ core (native/cdc_core.cpp —
+    dfs_anchored_spans + batched SHA) when the toolchain is available,
+    the NumPy oracle otherwise. Both are bit-identical to
+    chunk_file_anchored_np, which tests enforce."""
 
     name = "cdc-anchored"
 
     def chunk(self, data: bytes) -> list[ChunkRef]:
-        spans = chunk_file_anchored_np(_to_u8(data), self.params)
+        from dfs_tpu.native import (native_anchored_spans,
+                                    native_sha256_spans)
+
+        arr = _to_u8(data)
+        spans = native_anchored_spans(arr, self.params)
+        if spans is not None:
+            # spans tile arr contiguously, so hashing passes the array
+            # pointer + an offsets table — no per-chunk copies
+            digests = native_sha256_spans(arr, spans)
+            if digests is None:
+                import hashlib
+
+                mv = memoryview(np.ascontiguousarray(arr))
+                digests = [hashlib.sha256(mv[o:o + ln]).hexdigest()
+                           for o, ln in spans]
+            return [ChunkRef(index=i, offset=int(o), length=int(ln),
+                             digest=dg)
+                    for i, ((o, ln), dg) in enumerate(zip(spans, digests))]
+        out = chunk_file_anchored_np(arr, self.params)
         return [ChunkRef(index=i, offset=o, length=ln, digest=dg)
-                for i, (o, ln, dg) in enumerate(spans)]
+                for i, (o, ln, dg) in enumerate(out)]
 
 
 class AnchoredTpuFragmenter(_AnchoredBase):

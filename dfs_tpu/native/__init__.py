@@ -49,16 +49,41 @@ def get_lib() -> ctypes.CDLL | None:
             lib = ctypes.CDLL(str(_SO))
         except OSError:
             return None
-        lib.dfs_sha256_batch.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p]
-        lib.dfs_sha256_batch.restype = None
-        lib.dfs_gear_cuts.argtypes = [
-            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
-            ctypes.c_uint32, ctypes.c_uint64, ctypes.c_uint64,
-            ctypes.c_void_p, ctypes.c_uint64]
-        lib.dfs_gear_cuts.restype = ctypes.c_int64
+        try:
+            _bind(lib)
+        except AttributeError:
+            # stale prebuilt .so missing newer symbols (e.g. shipped in an
+            # image layer with a fresh mtime): rebuild once, else degrade
+            # to the Python fallbacks rather than crash the first caller
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(str(_SO))
+                _bind(lib)
+            except (OSError, AttributeError):
+                return None
         _lib = lib
         return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    """Declare the extern-C signatures (raises AttributeError on a stale
+    library missing newer symbols — get_lib handles that)."""
+    lib.dfs_sha256_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p]
+    lib.dfs_sha256_batch.restype = None
+    lib.dfs_gear_cuts.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+        ctypes.c_uint32, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_void_p, ctypes.c_uint64]
+    lib.dfs_gear_cuts.restype = ctypes.c_int64
+    lib.dfs_anchored_spans.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_void_p,
+        ctypes.c_uint64]
+    lib.dfs_anchored_spans.restype = ctypes.c_int64
 
 
 def native_sha256_many(chunks: list[bytes]) -> list[str] | None:
@@ -77,6 +102,58 @@ def native_sha256_many(chunks: list[bytes]) -> list[str] | None:
         offsets.ctypes.data, len(chunks), out.ctypes.data)
     raw = out.tobytes()
     return [raw[32 * i:32 * (i + 1)].hex() for i in range(len(chunks))]
+
+
+def native_anchored_spans(data: bytes | np.ndarray,
+                          params) -> np.ndarray | None:
+    """Anchored two-level CDC spans in C++ (bit-identical to
+    ops.cdc_anchored.chunk_spans_anchored_np); returns [n, 2] int64
+    (offset, length) or None if the native lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    arr = np.frombuffer(data, dtype=np.uint8) if isinstance(
+        data, (bytes, bytearray, memoryview)) else data
+    n = int(arr.shape[0])
+    if n == 0:
+        return np.zeros((0, 2), dtype=np.int64)
+    cp = params.chunk
+    # worst case: one cut per min_blocks plus one forced tail per segment
+    cap = n // (cp.min_blocks * 64) + n // params.seg_min + 3
+    spans = np.empty((cap, 2), dtype=np.uint64)
+    from dfs_tpu.ops.cdc_anchored import TILE_BYTES
+
+    wrote = lib.dfs_anchored_spans(
+        arr.ctypes.data, n, params.seed, params.seg_mask,
+        params.seg_min, params.seg_max, TILE_BYTES,
+        cp.seed, cp.mask, cp.min_blocks, cp.max_blocks,
+        spans.ctypes.data, cap)
+    if wrote < 0:
+        return None
+    return spans[:wrote].astype(np.int64)
+
+
+def native_sha256_spans(arr: np.ndarray,
+                        spans: np.ndarray) -> list[str] | None:
+    """Batch sha256 of contiguous in-order spans of ``arr`` — zero-copy:
+    the spans ARE the offsets table, so the data pointer is passed
+    straight through (materializing per-span bytes plus the batch join
+    would transiently hold ~3x the payload)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = int(spans.shape[0])
+    if n == 0:
+        return []
+    base = np.uint64(spans[0, 0])
+    offsets = np.empty(n + 1, dtype=np.uint64)
+    offsets[0] = base
+    offsets[1:] = base + np.cumsum(spans[:, 1].astype(np.uint64))
+    out = np.empty(n * 32, dtype=np.uint8)
+    lib.dfs_sha256_batch(arr.ctypes.data, offsets.ctypes.data, n,
+                         out.ctypes.data)
+    raw = out.tobytes()
+    return [raw[32 * i:32 * (i + 1)].hex() for i in range(n)]
 
 
 def native_gear_cuts(data: bytes | np.ndarray, table: np.ndarray, mask: int,

@@ -12,6 +12,7 @@
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+#include <new>
 
 namespace {
 
@@ -32,6 +33,16 @@ constexpr uint32_t K[64] = {
 
 inline uint32_t rotr(uint32_t x, int n) {
   return (x >> n) | (x << (32 - n));
+}
+
+// lowbias32 finalizer — must match dfs_tpu/ops/cdc_anchored._fmix32_np /
+// cdc_v2.fmix32_np exactly.
+inline uint32_t fmix32(uint32_t x) {
+  x ^= x >> 16;
+  x *= 0x7FEB352Du;
+  x ^= x >> 15;
+  x *= 0x846CA68Bu;
+  return x ^ (x >> 16);
 }
 
 void compress(uint32_t state[8], const uint8_t* block) {
@@ -124,6 +135,96 @@ int64_t dfs_gear_cuts(const uint8_t* data, uint64_t len,
     cuts[n_cuts++] = len;
   }
   return int64_t(n_cuts);
+}
+
+// Anchored two-level CDC spans — bit-identical to the NumPy oracle
+// (dfs_tpu/ops/cdc_anchored.chunk_spans_anchored_np): byte-granular
+// anchors (8-byte windowed hash, first-per-tile quantization) choose
+// segment boundaries; within each segment the 64-byte-aligned windowed
+// Gear grid re-anchors at the segment start. This is the fast host
+// engine for accelerator-less nodes running the flagship strategy.
+// Writes (offset, length) u64 pairs into `spans` (capacity span_cap
+// pairs); returns the pair count, or -1 on overflow/alloc failure.
+int64_t dfs_anchored_spans(const uint8_t* data, uint64_t len,
+                           uint32_t anchor_seed, uint32_t seg_mask,
+                           uint64_t seg_min, uint64_t seg_max,
+                           uint64_t tile_bytes, uint32_t chunk_seed,
+                           uint32_t avg_mask, uint64_t min_blocks,
+                           uint64_t max_blocks, uint64_t* spans,
+                           uint64_t span_cap) {
+  if (len == 0) return 0;
+
+  // ---- pass A: first qualifying anchor per tile (-1 = none) ----
+  uint64_t n_tiles = (len + tile_bytes - 1) / tile_bytes;
+  int64_t* tile_anchor = new (std::nothrow) int64_t[n_tiles];
+  if (!tile_anchor) return -1;
+  for (uint64_t t = 0; t < n_tiles; ++t) tile_anchor[t] = -1;
+  uint64_t reg = 0;  // bytes[p-7..p], data[p] in the top byte (LE window)
+  for (uint64_t p = 0; p < len; ++p) {
+    reg = (reg >> 8) | (uint64_t(data[p]) << 56);
+    uint32_t b = uint32_t(reg >> 32);
+    uint32_t a = uint32_t(reg);
+    uint32_t h = fmix32(fmix32(b) + anchor_seed + a);
+    if ((h & seg_mask) == 0) {
+      uint64_t t = p / tile_bytes;
+      if (tile_anchor[t] < 0) tile_anchor[t] = int64_t(p);
+    }
+  }
+
+  // ---- G table for the aligned windowed Gear (arithmetic form) ----
+  uint32_t G[256];
+  for (uint32_t v = 0; v < 256; ++v)
+    G[v] = fmix32(chunk_seed ^ (v * 0x9E3779B1u));
+
+  // ---- segment walk + per-segment aligned chunking ----
+  uint64_t n_spans = 0, start = 0;
+  bool ok = true;
+  while (ok) {
+    uint64_t bound;
+    if (len - start <= seg_max) {
+      bound = len;  // final segment
+    } else {
+      // last kept anchor a with start+seg_min <= a+1 <= start+seg_max
+      uint64_t lo = start + seg_min - 1, hi = start + seg_max - 1;
+      int64_t found = -1;
+      for (uint64_t t = hi / tile_bytes + 1; t-- > lo / tile_bytes;) {
+        int64_t a = tile_anchor[t];
+        if (a >= int64_t(lo) && a <= int64_t(hi)) { found = a; break; }
+      }
+      bound = found >= 0 ? uint64_t(found) + 1 : start + seg_max;
+    }
+
+    // aligned chunking of segment [start, bound), grid re-anchored
+    uint64_t seg_len = bound - start;
+    uint64_t nb = (seg_len + 63) / 64;         // incl. trailing partial
+    uint64_t full = seg_len / 64;              // candidate-eligible blocks
+    uint64_t since = 0, prev = 0;
+    for (uint64_t t = 0; t < nb; ++t) {
+      ++since;
+      bool cand = false;
+      if (t < full) {
+        const uint8_t* blk = data + start + 64 * t;
+        uint32_t h = 0;
+        for (int k = 0; k < 32; ++k) h += G[blk[63 - k]] << k;
+        cand = (h & avg_mask) == 0;
+      }
+      bool cut = (cand && since >= min_blocks) || since >= max_blocks ||
+                 t == nb - 1;
+      if (cut) {
+        if (n_spans == span_cap) { ok = false; break; }
+        uint64_t end = (t + 1) * 64 < seg_len ? (t + 1) * 64 : seg_len;
+        spans[2 * n_spans] = start + prev * 64;
+        spans[2 * n_spans + 1] = end - prev * 64;
+        ++n_spans;
+        prev = t + 1;
+        since = 0;
+      }
+    }
+    if (bound == len) break;
+    start = bound;
+  }
+  delete[] tile_anchor;
+  return ok ? int64_t(n_spans) : -1;
 }
 
 }  // extern "C"

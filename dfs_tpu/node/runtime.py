@@ -158,8 +158,10 @@ class StorageNodeServer:
                 self.counters.inc("announce_rejected_tombstoned")
             return {"ok": True}, b""
         if op == "tombstones":
+            ms = self.store.manifests
             return {"ok": True,
-                    "ids": self.store.manifests.tombstones()}, b""
+                    "tombs": [{"id": fid, "ts": ms.tombstone_ts(fid)}
+                              for fid in ms.tombstones()]}, b""
         if op == "get_chunk":
             data = self.store.chunks.get(header["digest"])
             if data is None:
@@ -449,28 +451,49 @@ class StorageNodeServer:
         return found
 
     async def _tombstone_antientropy(self) -> int:
-        """Pull peers' tombstones and apply any we don't know: a node that
-        slept through a delete learns of it here BEFORE re-replicating,
-        so its stale manifest can neither serve the file nor resurrect
-        its chunks onto peers. Returns #tombstones applied."""
+        """Pull peers' tombstones and converge by last-writer-wins: a node
+        that slept through a delete learns of it here BEFORE
+        re-replicating, so its stale manifest can neither serve the file
+        nor resurrect its chunks onto peers. Ordering matters the other
+        way too — a peer that slept through a *re-upload* still holds a
+        tombstone OLDER than our live manifest; applying it blindly would
+        destroy an acknowledged upload cluster-wide, so stale tombstones
+        are instead answered by re-announcing the newer manifest
+        (fresh=True clears the peer's tombstone). Returns #applied."""
         known = set(self.store.manifests.tombstones())
         applied = 0
         for peer in self._peers():
-            if not self.health.is_alive(peer.node_id):
-                continue
+            # no is_alive gate: a peer marked dead is exactly the one that
+            # may have rejoined lagging; one cheap attempt probes it
             try:
                 resp, _ = await self.client.call(
                     peer, {"op": "tombstones"}, retries=1)
+                self.health.mark_alive(peer.node_id)
             except RpcError:
                 continue
-            for fid in resp.get("ids", []):
+            for t in resp.get("tombs", []):
+                fid, ts = t.get("id"), t.get("ts")
                 # validate before applying: one malformed id from a skewed
                 # peer raising ValueError here would abort repair for every
                 # cycle and silently stop the cluster converging
-                if fid not in known and is_hex_digest(fid):
-                    self.store.manifests.delete(fid)   # writes tombstone
-                    known.add(fid)
-                    applied += 1
+                if fid in known or not is_hex_digest(fid):
+                    continue
+                local_mtime = self.store.manifests.mtime(fid)
+                if (local_mtime is not None and ts is not None
+                        and local_mtime > float(ts)):
+                    # our manifest postdates the delete: the tombstone is
+                    # stale — resurrect the file on the lagging peer
+                    m = self.store.manifests.load(fid)
+                    if m is not None:
+                        try:
+                            await self.client.announce(peer, m.to_json(),
+                                                       fresh=True)
+                        except RpcError:
+                            pass
+                    continue
+                self.store.manifests.delete(fid)       # writes tombstone
+                known.add(fid)
+                applied += 1
         if applied:
             self.store.gc()
             self.log.info("anti-entropy: applied %d tombstones", applied)

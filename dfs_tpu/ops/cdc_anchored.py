@@ -212,11 +212,13 @@ def chunk_file_anchored_np(data: np.ndarray, params: AnchoredCdcParams
 
 @functools.cache
 def make_anchor_fn(params: AnchoredCdcParams, m_words: int):
-    """Compiled: words_le [2 + m_words] u32 -> first-anchor byte position
-    per TILE_BYTES tile ([m_words*4/TILE_BYTES] i32; 2^30 = no anchor).
-    The leading 2 words are the 8 stream bytes BEFORE the region (zeros at
-    true stream start), so anchor hashes near the region start see real
-    history and batching is transparent; positions are region-local."""
+    """Compiled: words_le [>= 2 + m_words] u32 (extra trailing words —
+    the region buffer's lane slack — are ignored) -> first-anchor byte
+    position per TILE_BYTES tile ([m_words*4/TILE_BYTES] i32; 2^30 = no
+    anchor). The leading 2 words are the 8 stream bytes BEFORE the region
+    (zeros at true stream start), so anchor hashes near the region start
+    see real history and batching is transparent; positions are
+    region-local."""
     import jax
     import jax.numpy as jnp
 
@@ -232,7 +234,12 @@ def make_anchor_fn(params: AnchoredCdcParams, m_words: int):
         return x ^ (x >> jnp.uint32(16))
 
     @jax.jit
-    def run(words):
+    def run(words_full):
+        # accept the whole region buffer and slice inside the jit: a
+        # host-side words[:2+m] slice is a separate dispatch that
+        # materializes a full device copy (~1 ms per 64 MiB); in here XLA
+        # fuses the slice into the elementwise reads
+        words = jax.lax.slice_in_dim(words_full, 0, 2 + m_words)
         # b over region words -1..m-1 (one extra so a = b shifted one word)
         v, vp = words[1:], words[:-1]
         best = jnp.full((m_words,), jnp.int32(2**30))
@@ -591,7 +598,7 @@ def region_dispatch(words, n: int, start0, final: bool,
     if not isinstance(start0, jax.Array):
         start0 = _dev_i32(int(start0))
 
-    tiles = make_anchor_fn(params, m_words)(words[:2 + m_words])
+    tiles = make_anchor_fn(params, m_words)(words)
     bounds = make_select_fn(params, m_tiles, cap)(
         tiles, start0, _dev_i32(int(n)), _dev_bool(bool(final)))
     (starts, seg_lens, w_off, sh8, real_blocks, tail_len,

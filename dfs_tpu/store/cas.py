@@ -16,8 +16,10 @@ upload (SURVEY.md §5.4) is preserved by the node runtime.
 
 from __future__ import annotations
 
+import json
 import os
 import tempfile
+import time
 from pathlib import Path
 
 from dfs_tpu.meta.manifest import Manifest
@@ -158,16 +160,40 @@ class ManifestStore:
                 continue  # skip corrupt manifest rather than failing the listing
         return out
 
-    def delete(self, file_id: str, tombstone: bool = True) -> bool:
-        """Remove a manifest; by default leaves a persistent tombstone
-        (written first — crash between the two steps errs toward delete)."""
-        if tombstone:
-            _atomic_write(self._tomb_path(file_id), b"{}")
+    def delete(self, file_id: str) -> bool:
+        """Remove a manifest, leaving a persistent timestamped tombstone
+        (written first — crash between the two steps errs toward delete).
+        The timestamp orders deletes against re-uploads in anti-entropy
+        (last-writer-wins; wall clocks, the usual LWW skew caveat)."""
+        _atomic_write(self._tomb_path(file_id),
+                      json.dumps({"ts": time.time()}).encode())
         try:
             self._path(file_id).unlink()
             return True
         except FileNotFoundError:
             return False
+
+    def tombstone_ts(self, file_id: str) -> float | None:
+        """Deletion timestamp of a tombstone, or None if not tombstoned
+        (falls back to file mtime for unreadable tombstone bodies)."""
+        p = self._tomb_path(file_id)
+        try:
+            return float(json.loads(p.read_bytes())["ts"])
+        except FileNotFoundError:
+            return None
+        except (ValueError, KeyError, TypeError):
+            try:
+                return p.stat().st_mtime
+            except FileNotFoundError:
+                return None
+
+    def mtime(self, file_id: str) -> float | None:
+        """Manifest file mtime — the 'written at' ordering side of
+        last-writer-wins against tombstone timestamps."""
+        try:
+            return self._path(file_id).stat().st_mtime
+        except FileNotFoundError:
+            return None
 
 
 class NodeStore:

@@ -38,3 +38,36 @@ def test_native_matches_numpy_fragmenter(rng):
     got = native_gear_cuts(data, frag.table, PARAMS.mask,
                            PARAMS.min_size, PARAMS.max_size)
     assert got.tolist() == frag.cuts(data).tolist()
+
+
+def test_native_anchored_spans_matches_oracle(rng):
+    """dfs_anchored_spans must be bit-identical to the NumPy oracle on
+    random, low-entropy, tiny, and partial-block streams (the anchored
+    CPU fragmenter routes through it in production)."""
+    from dfs_tpu.native import native_anchored_spans
+    from dfs_tpu.ops.cdc_anchored import (AnchoredCdcParams,
+                                          chunk_spans_anchored_np)
+    from dfs_tpu.ops.cdc_v2 import AlignedCdcParams
+
+    params = AnchoredCdcParams(
+        chunk=AlignedCdcParams(min_blocks=2, avg_blocks=4, max_blocks=16,
+                               strip_blocks=64),
+        seg_min=2048, seg_max=4096, seg_mask=2047)
+    cases = [
+        rng.integers(0, 256, size=300_000, dtype=np.uint8),
+        rng.integers(0, 256, size=1, dtype=np.uint8),
+        rng.integers(0, 256, size=4097, dtype=np.uint8),   # partial block
+        np.zeros(100_000, dtype=np.uint8),                  # anchor-free
+        np.tile(rng.integers(0, 256, size=256, dtype=np.uint8), 400),
+    ]
+    for data in cases:
+        got = native_anchored_spans(data, params)
+        want = chunk_spans_anchored_np(data, params)
+        assert [(int(o), int(ln)) for o, ln in got] == want
+
+
+def test_native_anchored_empty():
+    from dfs_tpu.native import native_anchored_spans
+    from dfs_tpu.ops.cdc_anchored import AnchoredCdcParams
+
+    assert native_anchored_spans(b"", AnchoredCdcParams()).shape == (0, 2)

@@ -353,6 +353,44 @@ def test_reupload_after_delete_resurrects(tmp_path, rng):
     asyncio.run(run())
 
 
+def test_stale_tombstone_does_not_destroy_reupload(tmp_path, rng):
+    """LWW ordering: node 3 sleeps through delete + re-upload of the same
+    content, returns holding only the (older) tombstone. Anti-entropy must
+    NOT apply it over the newer live manifest anywhere — instead the stale
+    peer gets the manifest re-announced (fresh) and converges to alive."""
+    data = rng.integers(0, 256, size=40_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path,
+                                  retries=1, connect_timeout_s=0.3)
+        try:
+            m1, _ = await nodes[1].upload(data, "lww.bin")
+            fid = m1.file_id
+            assert await nodes[1].delete(fid)       # all 3 tombstoned
+            await nodes.pop(3).stop()               # sleeps through re-up
+            await asyncio.sleep(0.05)               # mtime strictly newer
+            m2, _ = await nodes[1].upload(data, "lww.bin")
+            assert m2.file_id == fid
+
+            nodes.update(await start_nodes(cluster, tmp_path, ids={3},
+                                           retries=1, connect_timeout_s=0.3))
+            assert nodes[3].store.manifests.is_tombstoned(fid)
+            # any survivor's repair sees node 3's stale tombstone: must
+            # keep its live manifest and resurrect node 3 instead
+            await nodes[1].repair_once()
+            assert nodes[1].store.manifests.load(fid) is not None
+            assert not nodes[1].store.manifests.is_tombstoned(fid)
+            assert nodes[3].store.manifests.load(fid) is not None
+            assert not nodes[3].store.manifests.is_tombstoned(fid)
+            _, got = await nodes[2].download(fid)
+            assert got == data
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
 def test_download_tombstoned_rejected_despite_stale_peer(tmp_path, rng):
     """A node that knows the file is deleted must 404 even while a stale
     peer still has the manifest + chunks (no resurrection via the

@@ -102,15 +102,17 @@ async def run_bench(total: int, n_files: int, n_nodes: int, root: Path):
     log(f"ingest: {t_up:.2f}s ({total / t_up / 2**30:.3f} GiB/s incl. "
         f"2x replication)")
 
-    # healthy-cluster download baseline (one warmup pass first: lazy
-    # imports + allocator warmup otherwise land in the healthy number and
-    # make the degraded pass look faster than the healthy one)
+    # healthy-cluster download baseline, from the SAME node the degraded
+    # pass will use (per-node local-chunk shares differ, so mixing nodes
+    # would conflate node identity with degradation), with one warmup
+    # pass first (lazy imports + allocator warmup otherwise land in the
+    # healthy number)
     for fid, data in manifests:
-        _, got = await nodes[2].download(fid)
+        _, got = await nodes[1].download(fid)
         assert got == data
     t0 = time.perf_counter()
     for fid, data in manifests:
-        _, got = await nodes[2].download(fid)
+        _, got = await nodes[1].download(fid)
         assert got == data
     t_healthy = time.perf_counter() - t0
     log(f"healthy reconstruct: {t_healthy:.2f}s "

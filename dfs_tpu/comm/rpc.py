@@ -113,6 +113,14 @@ class InternalClient:
         _, body = await self.call(peer, {"op": "get_chunk", "digest": digest})
         return body
 
+    async def get_chunks(self, peer: PeerAddr,
+                         digests: list[str]) -> list[tuple[str, bytes]]:
+        """Batched fetch: returns (digest, bytes) for every requested
+        chunk the peer holds (missing ones are absent — no error)."""
+        resp, body = await self.call(
+            peer, {"op": "get_chunks", "digests": digests})
+        return unpack_chunks(resp.get("chunks", []), body)
+
     async def get_manifest(self, peer: PeerAddr, file_id: str) -> str | None:
         resp, _ = await self.call(peer, {"op": "get_manifest", "fileId": file_id})
         return resp.get("manifest")

@@ -9,6 +9,7 @@ is unavailable — the framework never *requires* the native library.
 from __future__ import annotations
 
 import ctypes
+import os
 import subprocess
 import threading
 from pathlib import Path
@@ -25,13 +26,20 @@ _tried = False
 
 
 def _build() -> bool:
+    # compile to a temp path and rename over the target: rebuilding in
+    # place would truncate an inode this (or another) process may have
+    # dlopen'd/mmapped — SIGBUS territory; rename swaps a fresh inode in
+    # atomically for concurrent loaders too
+    tmp = _SO.with_suffix(f".tmp{os.getpid()}.so")
     try:
         subprocess.run(
             ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-             str(_SRC), "-o", str(_SO)],
+             str(_SRC), "-o", str(tmp)],
             check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
         return True
     except (OSError, subprocess.SubprocessError):
+        tmp.unlink(missing_ok=True)
         return False
 
 
@@ -114,6 +122,7 @@ def native_anchored_spans(data: bytes | np.ndarray,
         return None
     arr = np.frombuffer(data, dtype=np.uint8) if isinstance(
         data, (bytes, bytearray, memoryview)) else data
+    arr = np.ascontiguousarray(arr)    # .ctypes.data needs C-contiguity
     n = int(arr.shape[0])
     if n == 0:
         return np.zeros((0, 2), dtype=np.int64)
@@ -142,6 +151,7 @@ def native_sha256_spans(arr: np.ndarray,
     lib = get_lib()
     if lib is None:
         return None
+    arr = np.ascontiguousarray(arr)    # .ctypes.data needs C-contiguity
     n = int(spans.shape[0])
     if n == 0:
         return []

@@ -160,13 +160,18 @@ class ManifestStore:
                 continue  # skip corrupt manifest rather than failing the listing
         return out
 
-    def delete(self, file_id: str) -> bool:
+    def delete(self, file_id: str, ts: float | None = None) -> bool:
         """Remove a manifest, leaving a persistent timestamped tombstone
         (written first — crash between the two steps errs toward delete).
         The timestamp orders deletes against re-uploads in anti-entropy
-        (last-writer-wins; wall clocks, the usual LWW skew caveat)."""
+        (last-writer-wins; wall clocks, the usual LWW skew caveat).
+        ``ts`` carries the ORIGIN deletion time when a tombstone is being
+        propagated — re-stamping with the local apply time would advance
+        the timestamp as it gossips until it postdates (and destroys) a
+        legitimate re-upload."""
         _atomic_write(self._tomb_path(file_id),
-                      json.dumps({"ts": time.time()}).encode())
+                      json.dumps({"ts": time.time() if ts is None
+                                  else float(ts)}).encode())
         try:
             self._path(file_id).unlink()
             return True

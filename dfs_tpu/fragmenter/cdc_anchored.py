@@ -66,24 +66,20 @@ class AnchoredCpuFragmenter(_AnchoredBase):
     name = "cdc-anchored"
 
     def chunk(self, data: bytes) -> list[ChunkRef]:
-        from dfs_tpu.native import (native_anchored_spans,
-                                    native_sha256_spans)
+        import hashlib
+
+        from dfs_tpu.native import native_anchored_spans
 
         arr = _to_u8(data)
         spans = native_anchored_spans(arr, self.params)
         if spans is not None:
-            # spans tile arr contiguously, so hashing passes the array
-            # pointer + an offsets table — no per-chunk copies
-            digests = native_sha256_spans(arr, spans)
-            if digests is None:
-                import hashlib
-
-                mv = memoryview(np.ascontiguousarray(arr))
-                digests = [hashlib.sha256(mv[o:o + ln]).hexdigest()
-                           for o, ln in spans]
+            # digests via hashlib over zero-copy memoryview slices:
+            # OpenSSL's SHA-NI path measured 5x the portable C++ batch
+            mv = memoryview(np.ascontiguousarray(arr))
             return [ChunkRef(index=i, offset=int(o), length=int(ln),
-                             digest=dg)
-                    for i, ((o, ln), dg) in enumerate(zip(spans, digests))]
+                             digest=hashlib.sha256(
+                                 mv[o:o + ln]).hexdigest())
+                    for i, (o, ln) in enumerate(spans)]
         out = chunk_file_anchored_np(arr, self.params)
         return [ChunkRef(index=i, offset=o, length=ln, digest=dg)
                 for i, (o, ln, dg) in enumerate(out)]

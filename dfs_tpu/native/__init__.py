@@ -95,8 +95,12 @@ def _bind(lib: ctypes.CDLL) -> None:
 
 
 def native_sha256_many(chunks: list[bytes]) -> list[str] | None:
-    """Batch sha256 via the native lib; None if unavailable (caller falls
-    back to hashlib)."""
+    """Batch sha256 via the native lib; None if unavailable.
+
+    NOT a Python-side accelerator: hashlib's OpenSSL SHA-NI path measured
+    5x faster. This binding exists to validate the C ABI that a
+    non-Python host (the reference's Java-calls-sidecar shape) would link
+    — production Python paths use hashlib."""
     lib = get_lib()
     if lib is None or not chunks:
         return None if lib is None else []
@@ -140,30 +144,6 @@ def native_anchored_spans(data: bytes | np.ndarray,
     if wrote < 0:
         return None
     return spans[:wrote].astype(np.int64)
-
-
-def native_sha256_spans(arr: np.ndarray,
-                        spans: np.ndarray) -> list[str] | None:
-    """Batch sha256 of contiguous in-order spans of ``arr`` — zero-copy:
-    the spans ARE the offsets table, so the data pointer is passed
-    straight through (materializing per-span bytes plus the batch join
-    would transiently hold ~3x the payload)."""
-    lib = get_lib()
-    if lib is None:
-        return None
-    arr = np.ascontiguousarray(arr)    # .ctypes.data needs C-contiguity
-    n = int(spans.shape[0])
-    if n == 0:
-        return []
-    base = np.uint64(spans[0, 0])
-    offsets = np.empty(n + 1, dtype=np.uint64)
-    offsets[0] = base
-    offsets[1:] = base + np.cumsum(spans[:, 1].astype(np.uint64))
-    out = np.empty(n * 32, dtype=np.uint8)
-    lib.dfs_sha256_batch(arr.ctypes.data, offsets.ctypes.data, n,
-                         out.ctypes.data)
-    raw = out.tobytes()
-    return [raw[32 * i:32 * (i + 1)].hex() for i in range(n)]
 
 
 def native_gear_cuts(data: bytes | np.ndarray, table: np.ndarray, mask: int,

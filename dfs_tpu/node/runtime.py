@@ -25,6 +25,7 @@ Deliberate upgrades over the reference, per SURVEY.md §2.5 / §5.3:
 from __future__ import annotations
 
 import asyncio
+import math
 from typing import Iterable
 
 from dfs_tpu.comm.rpc import InternalClient, RpcError, RpcUnreachable
@@ -446,7 +447,9 @@ class StorageNodeServer:
                 except RpcUnreachable:
                     self.health.mark_dead(node_id)
                     got = []
-                except RpcError:
+                except (RpcError, WireError):
+                    # WireError: peer sent a malformed chunk table — as
+                    # recoverable as corrupt bytes; other replicas serve
                     got = []
                 if got:
                     hexes = sha256_many_hex([b for _, b in got])
@@ -582,6 +585,8 @@ class StorageNodeServer:
                     continue
                 try:
                     ts = None if ts is None else float(ts)
+                    if ts is not None and not math.isfinite(ts):
+                        continue   # NaN defeats every LWW comparison
                 except (TypeError, ValueError):
                     continue
                 local_mtime = self.store.manifests.mtime(fid)

@@ -24,17 +24,10 @@ def sha256_hex(data: bytes | bytearray | memoryview | np.ndarray) -> str:
 
 
 def sha256_many_hex(chunks: list[bytes]) -> list[str]:
-    """Digest a batch of byte strings. Uses the native C++ library when
-    available, else hashlib. Kept as a single entry point so the CPU
-    fragmenters get native acceleration for free."""
-    try:
-        from dfs_tpu.native import native_sha256_many
-
-        out = native_sha256_many(chunks)
-        if out is not None:
-            return out
-    except Exception:  # pragma: no cover - native lib is optional
-        pass
+    """Digest a batch of byte strings via hashlib. Measured: OpenSSL's
+    SHA-NI assembly under hashlib runs 1.0 GiB/s vs 0.19 for the portable
+    C++ batch in dfs_tpu/native (which exists for non-Python hosts linking
+    the library, not as a Python accelerator)."""
     return [hashlib.sha256(c).hexdigest() for c in chunks]
 
 

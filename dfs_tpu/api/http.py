@@ -26,9 +26,11 @@ from urllib.parse import parse_qs, unquote, urlsplit
 if TYPE_CHECKING:
     from dfs_tpu.node.runtime import StorageNodeServer
 
-_REASONS = {200: "OK", 201: "Created", 400: "Bad Request", 404: "Not Found",
+_REASONS = {200: "OK", 201: "Created", 206: "Partial Content",
+            400: "Bad Request", 404: "Not Found",
             405: "Method Not Allowed", 411: "Length Required",
-            413: "Payload Too Large", 500: "Internal Server Error"}
+            413: "Payload Too Large",
+            416: "Range Not Satisfiable", 500: "Internal Server Error"}
 MAX_BODY = 4 * 1024 * 1024 * 1024
 
 
@@ -68,6 +70,24 @@ def binary(status: int, data: bytes, filename: str) -> bytes:
                  {"Content-Disposition": f'attachment; filename="{safe}"'})
 
 
+def _parse_range(value: str) -> tuple[int | None, int | None] | None:
+    """Parse a single-range ``bytes=`` header into (first, last) with
+    either side possibly open: 'bytes=a-b' -> (a, b), 'bytes=a-' ->
+    (a, None), 'bytes=-n' -> (None, n). Multi-range and malformed ->
+    None (caller answers 400)."""
+    if not value.startswith("bytes=") or "," in value:
+        return None
+    spec = value[len("bytes="):].strip()
+    first, _, last = spec.partition("-")
+    if _ != "-" or (not first and not last):
+        return None
+    try:
+        return (int(first) if first else None,
+                int(last) if last else None)
+    except ValueError:
+        return None
+
+
 def make_http_handler(node: "StorageNodeServer"):
     import time
 
@@ -98,7 +118,7 @@ def make_http_handler(node: "StorageNodeServer"):
 async def _serve_one(node: "StorageNodeServer",
                      reader: asyncio.StreamReader) -> bytes:
     from dfs_tpu.node.runtime import (DownloadError, NotFoundError,
-                                      UploadError)
+                                      RangeNotSatisfiable, UploadError)
 
     request_line = (await reader.readline()).decode("latin-1").strip()
     if not request_line:
@@ -112,17 +132,21 @@ async def _serve_one(node: "StorageNodeServer",
     query = {k: v[0] for k, v in parse_qs(split.query).items()}
 
     content_length: int | None = None
+    range_header: str | None = None
     while True:
         line = (await reader.readline()).decode("latin-1")
         if line in ("\r\n", "\n", ""):
             break
         if ":" in line:
             k, v = line.split(":", 1)
-            if k.strip().lower() == "content-length":
+            key = k.strip().lower()
+            if key == "content-length":
                 try:
                     content_length = int(v.strip())
                 except ValueError:
                     return plain(400, "Bad Content-Length")
+            elif key == "range":
+                range_header = v.strip()
 
     node.counters.inc("http_requests")
 
@@ -173,12 +197,38 @@ async def _serve_one(node: "StorageNodeServer",
         if _bad_id(file_id):
             return plain(400, "Bad fileId")
         try:
+            if range_header is not None:
+                # partial read: chunk-granular manifests make byte ranges
+                # cheap (only overlapping chunks are gathered) — surface
+                # the reference never had (no range requests anywhere,
+                # SURVEY.md §2.5(5)); satisfiability is resolved in ONE
+                # place (download_range), this layer only parses/formats
+                rng = _parse_range(range_header)
+                if rng is None:
+                    return plain(400, "Bad Range")
+                try:
+                    manifest, data, start, end = await node.download_range(
+                        file_id, *rng)
+                except RangeNotSatisfiable as e:
+                    return _resp(416, b"", "text/plain",
+                                 {"Content-Range": f"bytes */{e.size}"})
+                return _resp(
+                    206, data, "application/octet-stream",
+                    {"Content-Range":
+                     f"bytes {start}-{end - 1}/{manifest.size}",
+                     "Accept-Ranges": "bytes"})
             manifest, data = await node.download(file_id)
         except NotFoundError:
             return plain(404, "File not found")
         except DownloadError as e:
             return plain(500, str(e))
         return binary(200, data, manifest.name)
+
+    if method == "POST" and path == "/scrub":
+        # verify every local chunk against its content address; corrupt
+        # ones are evicted and queued for repair (reference has no
+        # integrity scanning at all — read-time whole-file check only)
+        return as_json(200, await node.scrub_once())
 
     if method == "POST" and path == "/repair":
         # Operator-triggered re-replication (the serve loop also runs this

@@ -31,8 +31,11 @@ class NodeClient:
         self.base = f"http://{host}:{port}"
         self.timeout_s = timeout_s
 
-    def _request(self, method: str, path: str, body: bytes | None = None) -> bytes:
-        req = urllib.request.Request(self.base + path, data=body, method=method)
+    def _request(self, method: str, path: str, body: bytes | None = None,
+                 headers: dict | None = None) -> bytes:
+        req = urllib.request.Request(self.base + path, data=body,
+                                     method=method,
+                                     headers=headers or {})
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 return r.read()
@@ -56,6 +59,15 @@ class NodeClient:
     def download(self, file_id: str) -> bytes:
         q = urllib.parse.urlencode({"fileId": file_id})
         return self._request("GET", f"/download?{q}")
+
+    def download_range(self, file_id: str, start: int, end: int) -> bytes:
+        """Bytes [start, end) via an HTTP Range request (206)."""
+        q = urllib.parse.urlencode({"fileId": file_id})
+        return self._request("GET", f"/download?{q}",
+                             headers={"Range": f"bytes={start}-{end - 1}"})
+
+    def scrub(self) -> dict:
+        return json.loads(self._request("POST", "/scrub", body=b""))
 
     def manifest(self, file_id: str) -> dict:
         q = urllib.parse.urlencode({"fileId": file_id})

@@ -33,7 +33,7 @@ from dfs_tpu.comm.wire import (WireError, pack_chunks, read_msg, send_msg,
                                unpack_chunks)
 from dfs_tpu.config import NodeConfig
 from dfs_tpu.fragmenter.base import get_fragmenter
-from dfs_tpu.meta.manifest import Manifest
+from dfs_tpu.meta.manifest import ChunkRef, Manifest
 from dfs_tpu.node.health import HealthMonitor
 from dfs_tpu.node.placement import replica_set
 from dfs_tpu.store.cas import NodeStore
@@ -54,6 +54,14 @@ class NotFoundError(KeyError):
 class DownloadError(RuntimeError):
     """Maps to HTTP 500 'Could not retrieve fragment…' / 'File corrupted'
     (StorageNode.java:443-446, 453-458)."""
+
+
+class RangeNotSatisfiable(DownloadError):
+    """A byte range past EOF — maps to HTTP 416 with the file size."""
+
+    def __init__(self, size: int) -> None:
+        super().__init__(f"range not satisfiable (size {size})")
+        self.size = size
 
 
 class StorageNodeServer:
@@ -396,15 +404,19 @@ class StorageNodeServer:
 
     _FETCH_BATCH_BYTES = 32 * 1024 * 1024
 
-    async def _gather_chunks(self, manifest: Manifest) -> dict[str, bytes]:
-        """Collect every chunk of a manifest: local first, then BATCHED
-        remote fetches grouped by preferred replica holder (one RPC per
-        ~32 MiB of chunks per peer — the per-chunk op costs a round-trip
-        per chunk and dominated degraded reads), with the per-chunk
-        replica-fallback path (:meth:`_fetch_chunk`) mopping up anything a
-        peer turned out not to hold. Returns digest -> verified bytes."""
+    async def _gather_chunks(self, manifest: Manifest | None,
+                             chunks=None,
+                             strict: bool = True) -> dict[str, bytes]:
+        """Collect chunks (default: all of the manifest's): local first,
+        then BATCHED remote fetches grouped by preferred replica holder
+        (one RPC per ~32 MiB of chunks per peer — the per-chunk op costs
+        a round-trip per chunk and dominated degraded reads), with the
+        per-chunk replica-fallback path (:meth:`_fetch_chunk`) mopping up
+        anything a peer turned out not to hold. Returns digest ->
+        verified bytes; ``strict=False`` skips unrecoverable chunks
+        instead of raising (repair's best-effort restore)."""
         need: dict[str, int] = {}
-        for c in manifest.chunks:
+        for c in (manifest.chunks if chunks is None else chunks):
             need.setdefault(c.digest, c.length)
         out: dict[str, bytes] = {}
         for d in list(need):
@@ -489,12 +501,16 @@ class StorageNodeServer:
 
             async def one(d: str) -> None:
                 async with sem:
-                    out[d] = await self._fetch_chunk(d, need[d])
+                    try:
+                        out[d] = await self._fetch_chunk(d, need[d])
+                    except DownloadError:
+                        if strict:
+                            raise
 
             await asyncio.gather(*(one(d) for d in missing))
         return out
 
-    async def download(self, file_id: str) -> tuple[Manifest, bytes]:
+    async def _resolve_manifest(self, file_id: str) -> Manifest:
         manifest = self.store.manifests.load(file_id)
         if manifest is None and self.store.manifests.is_tombstoned(file_id):
             # deleted — without this gate the peer fallback below would
@@ -515,6 +531,57 @@ class StorageNodeServer:
                     break
         if manifest is None:
             raise NotFoundError(file_id)
+        return manifest
+
+    async def download_range(self, file_id: str, first: int | None,
+                             last: int | None
+                             ) -> tuple[Manifest, bytes, int, int]:
+        """Serve an HTTP-style byte range ((first, last) as parsed from a
+        single-range ``bytes=`` header; either side may be open) — only
+        the chunks overlapping it are gathered, the partial-read
+        capability chunk-granular manifests buy (the reference can only
+        assemble whole files, StorageNode.java:399-461). Range
+        satisfiability is resolved HERE, against the resolved manifest,
+        so exactly one clamp exists. Returns (manifest, data, start, end).
+
+        The whole-file hash gate cannot apply to a partial read, so local
+        chunk copies are digest-verified up front; a rotten one is
+        evicted + queued for repair and the gather re-fetches it from a
+        healthy replica (remote bytes are already verified in the
+        gather). Raises :class:`RangeNotSatisfiable` past EOF."""
+        manifest = await self._resolve_manifest(file_id)
+        size = manifest.size
+        if first is None:                   # suffix: last N bytes
+            if not last:
+                raise RangeNotSatisfiable(size)
+            start, end = max(0, size - last), size
+        else:
+            start = first
+            end = size if last is None else min(last + 1, size)
+        if start >= size or start >= end:
+            raise RangeNotSatisfiable(size)
+
+        wanted = [c for c in manifest.chunks
+                  if c.offset < end and c.offset + c.length > start]
+        for c in wanted:
+            b = self.store.chunks.get(c.digest)
+            if b is not None and sha256_hex(b) != c.digest:
+                self.store.chunks.delete(c.digest)
+                self.under_replicated.add(c.digest)
+                self.log.warning("evicted corrupt local chunk %s on "
+                                 "range read", c.digest[:12])
+        by_digest = await self._gather_chunks(manifest, chunks=wanted)
+        parts = []
+        for c in wanted:
+            b = by_digest[c.digest]
+            lo = max(0, start - c.offset)
+            hi = min(c.length, end - c.offset)
+            parts.append(b[lo:hi])
+        self.counters.inc("range_downloads")
+        return manifest, b"".join(parts), start, end
+
+    async def download(self, file_id: str) -> tuple[Manifest, bytes]:
+        manifest = await self._resolve_manifest(file_id)
 
         with span("download.gather", self.latency):
             by_digest = await self._gather_chunks(manifest)
@@ -625,6 +692,7 @@ class StorageNodeServer:
         rf = self.cfg.cluster.replication_factor
         need: dict[int, list[tuple[str, int]]] = {}
         chunk_len: dict[str, int] = {}
+        own_missing: dict[str, int] = {}
         for m in self.store.manifests.list():
             for c in m.chunks:
                 chunk_len[c.digest] = c.length
@@ -632,8 +700,26 @@ class StorageNodeServer:
                     if target != self.cfg.node_id:
                         need.setdefault(target, []).append(
                             (c.digest, c.length))
+                    elif not self.store.chunks.has(c.digest):
+                        own_missing[c.digest] = c.length
 
         repaired = 0
+        # restore this node's OWN canonical copies first (lost to scrub
+        # eviction or disk faults) — pushing to peers alone would leave
+        # the local replica count permanently short. Batched via the same
+        # grouped-fetch path downloads use (per-chunk RPCs measured ~7x
+        # slower on the reconstruct bench).
+        if own_missing:
+            refs = [ChunkRef(index=0, offset=0, length=ln, digest=d)
+                    for d, ln in own_missing.items()]
+            got = await self._gather_chunks(None, chunks=refs,
+                                            strict=False)
+            for d, b in got.items():
+                if self.store.chunks.put(d, b, verify=False):
+                    self.counters.inc("chunks_stored")
+                    self.counters.inc("bytes_stored", len(b))
+                repaired += 1
+                self.under_replicated.discard(d)
         verified: set[str] = set()
         for node_id, wanted in need.items():
             peer = self.cfg.cluster.peer(node_id)
@@ -665,3 +751,30 @@ class StorageNodeServer:
         # only drop repair entries we actually confirmed on a peer
         self.under_replicated -= verified
         return repaired
+
+    async def scrub_once(self) -> dict:
+        """Verify every local chunk against its content address; delete
+        any whose bytes no longer hash to their digest (bit rot, partial
+        writes the atomic-rename discipline should prevent, disk faults)
+        and queue them for repair — the next repair_once re-fetches from
+        a replica and re-replicates. The reference's only integrity check
+        runs at read time on the whole file (StorageNode.java:453-458);
+        scrubbing finds rot before a read does."""
+        scanned = corrupt = 0
+        for d in self.store.chunks.digests():
+            b = self.store.chunks.get(d)
+            if b is None:
+                continue
+            scanned += 1
+            if sha256_hex(b) != d:
+                corrupt += 1
+                self.store.chunks.delete(d)
+                self.under_replicated.add(d)
+                self.log.warning("scrub: corrupt chunk %s deleted", d[:12])
+            # yield the event loop between chunks: scrubbing is a
+            # background activity, not a latency spike for live requests
+            await asyncio.sleep(0)
+        self.counters.inc("scrubs")
+        if corrupt:
+            self.counters.inc("scrub_corrupt", corrupt)
+        return {"scanned": scanned, "corrupt": corrupt}

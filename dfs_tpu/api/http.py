@@ -88,6 +88,34 @@ def _parse_range(value: str) -> tuple[int | None, int | None] | None:
         return None
 
 
+async def _chunked_body(reader: asyncio.StreamReader, limit: int = MAX_BODY):
+    """Async generator over an HTTP/1.1 chunked-transfer body. Raises
+    ValueError on malformed framing; enforces a cumulative size cap."""
+    total = 0
+    while True:
+        line = (await reader.readline()).decode("latin-1").strip()
+        if not line:
+            raise ValueError("missing chunk size")
+        try:
+            size = int(line.split(";", 1)[0], 16)  # ignore extensions
+        except ValueError as e:
+            raise ValueError(f"bad chunk size {line!r}") from e
+        if size == 0:
+            # consume trailer section up to the blank line
+            while True:
+                t = await reader.readline()
+                if t in (b"\r\n", b"\n", b""):
+                    return
+        total += size
+        if total > limit:
+            raise ValueError("chunked body exceeds size cap")
+        data = await reader.readexactly(size)
+        crlf = await reader.readexactly(2)
+        if crlf != b"\r\n":
+            raise ValueError("missing chunk terminator")
+        yield data
+
+
 def make_http_handler(node: "StorageNodeServer"):
     import time
 
@@ -133,6 +161,7 @@ async def _serve_one(node: "StorageNodeServer",
 
     content_length: int | None = None
     range_header: str | None = None
+    chunked = False
     while True:
         line = (await reader.readline()).decode("latin-1")
         if line in ("\r\n", "\n", ""):
@@ -147,6 +176,8 @@ async def _serve_one(node: "StorageNodeServer",
                     return plain(400, "Bad Content-Length")
             elif key == "range":
                 range_header = v.strip()
+            elif key == "transfer-encoding":
+                chunked = "chunked" in v.strip().lower()
 
     node.counters.inc("http_requests")
 
@@ -176,6 +207,22 @@ async def _serve_one(node: "StorageNodeServer",
         return _resp(200, m.to_json().encode(), "application/json")
 
     if method == "POST" and path == "/upload":
+        if chunked:
+            # streaming ingest: the chunked-transfer body feeds the
+            # fragmenter's bounded-memory pipeline as it arrives — the
+            # whole payload never exists in node memory (the reference
+            # reads the entire body into one array, StorageNode.java:124)
+            try:
+                manifest, stats = await node.upload_stream(
+                    _chunked_body(reader), query.get("name", ""))
+            except UploadError as e:
+                return plain(500, str(e))
+            except ValueError as e:
+                return plain(400, f"Bad chunked body: {e}")
+            return as_json(201, {"fileId": manifest.file_id,
+                                 "name": manifest.name,
+                                 "size": manifest.size,
+                                 "chunks": manifest.total_chunks, **stats})
         if content_length is None:
             return plain(411, "Length Required")  # reference parity
         if content_length > MAX_BODY:

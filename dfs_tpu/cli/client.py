@@ -56,6 +56,14 @@ class NodeClient:
         q = urllib.parse.urlencode({"name": name})
         return json.loads(self._request("POST", f"/upload?{q}", body=data))
 
+    def upload_stream(self, blocks, name: str) -> dict:
+        """Stream an upload with chunked transfer encoding (urllib sends
+        chunked automatically for length-less iterables) — the node
+        ingests it in bounded memory."""
+        q = urllib.parse.urlencode({"name": name})
+        return json.loads(self._request("POST", f"/upload?{q}",
+                                        body=iter(blocks)))
+
     def download(self, file_id: str) -> bytes:
         q = urllib.parse.urlencode({"fileId": file_id})
         return self._request("GET", f"/download?{q}")

@@ -216,33 +216,190 @@ class StorageNodeServer:
         with span("upload.fragment", self.latency):
             manifest = self.fragmenter.manifest(data, name=name,
                                                 file_id=file_id)
+
+        stats = self._new_upload_stats()
+        stats["bytes"] = len(data)
+        seen: set[str] = set()
+        batch: list[tuple[str, bytes]] = []
+        for c in manifest.chunks:
+            if c.digest in seen:
+                continue  # duplicate content within the file: place once
+            seen.add(c.digest)
+            # slice once; the same bytes object is shared across targets
+            batch.append((c.digest, data[c.offset:c.offset + c.length]))
+        stats["uniqueChunks"] = len(seen)
+        await self._place_batch(file_id, batch, stats)
+        await self._finalize_upload(manifest)
+        self.counters.inc("upload_bytes", len(data))
+        return manifest, stats
+
+    _STREAM_FLUSH_BYTES = 32 * 1024 * 1024
+
+    async def upload_stream(self, blocks, name: str) -> tuple[Manifest, dict]:
+        """Bounded-memory ingest: ``blocks`` is an async iterator of byte
+        blocks (e.g. an HTTP chunked-transfer body). The fragmenter's
+        pipelined streaming walk runs in a worker thread consuming the
+        blocks; finished chunks flow back and are placed/replicated in
+        ~32 MiB batches as the stream arrives — at no point does the
+        whole payload exist in node memory (the reference reads the
+        entire body into one array, StorageNode.java:124). file_id stays
+        sha256(whole stream), computed incrementally."""
+        import hashlib
+        import queue as _queue
+        import threading
+
+        loop = asyncio.get_running_loop()
+        inq: _queue.Queue = _queue.Queue(maxsize=4)
+        outq: asyncio.Queue = asyncio.Queue()
+        hasher = hashlib.sha256()
+        frag_dead = threading.Event()
+        aborted = threading.Event()
+        # chunk credits: the fragmenter thread blocks once this many
+        # produced chunks are unconsumed, which stops it draining inq,
+        # which blocks the feeder, which stops reading the socket — TCP
+        # backpressure end to end. Without it a fast client outruns slow
+        # replication and the 'bounded-memory' contract silently fails.
+        credits = threading.Semaphore(256)
+
+        def feed_iter():
+            while True:
+                b = inq.get()
+                if b is None:
+                    return
+                yield b
+
+        def on_chunk(digest: str, payload: bytes) -> None:
+            while not credits.acquire(timeout=0.5):
+                if aborted.is_set():
+                    raise RuntimeError("upload aborted")
+            loop.call_soon_threadsafe(outq.put_nowait, (digest, payload))
+
+        def run_fragmenter():
+            try:
+                m = self.fragmenter.manifest_stream(
+                    feed_iter(), name=name or "stream", store=on_chunk)
+                loop.call_soon_threadsafe(outq.put_nowait, ("done", m))
+            except BaseException as e:  # surfaced to the async side
+                loop.call_soon_threadsafe(outq.put_nowait, ("error", e))
+            finally:
+                frag_dead.set()
+
+        def put_block(b) -> None:
+            # bounded put that cannot deadlock: if the fragmenter thread
+            # died it stopped draining inq, so give up instead of blocking
+            # a worker thread (and the feeder await) forever
+            while not frag_dead.is_set():
+                try:
+                    inq.put(b, timeout=0.5)
+                    return
+                except _queue.Full:
+                    continue
+
+        frag_task = asyncio.create_task(asyncio.to_thread(run_fragmenter))
+
+        async def feeder() -> int:
+            total = 0
+            try:
+                async for b in blocks:
+                    if aborted.is_set():
+                        break        # placement failed: stop reading, do
+                        # NOT drain the rest of the body into memory
+                    total += len(b)
+                    hasher.update(b)
+                    await asyncio.to_thread(put_block, b)
+            finally:
+                await asyncio.to_thread(put_block, None)
+            return total
+
+        feed_task = asyncio.create_task(feeder())
+
+        stats = self._new_upload_stats()
+        seen: set[str] = set()
+        batch: list[tuple[str, bytes]] = []
+        pending = 0
+        manifest: Manifest | None = None
+        # file_id is only known at stream end; batches placed before that
+        # tag transfers with a placeholder (store_chunks ignores it)
+        try:
+            while manifest is None:
+                item = await outq.get()
+                if item[0] == "error" and isinstance(item[1], BaseException):
+                    raise UploadError(f"fragmenter failed: {item[1]}")
+                if item[0] == "done" and isinstance(item[1], Manifest):
+                    manifest = item[1]
+                    break
+                credits.release()
+                digest, payload = item
+                if digest in seen:
+                    continue
+                seen.add(digest)
+                batch.append((digest, payload))
+                pending += len(payload)
+                if pending >= self._STREAM_FLUSH_BYTES:
+                    await self._place_batch("", batch, stats)
+                    batch, pending = [], 0
+            if batch:
+                await self._place_batch("", batch, stats)
+        except BaseException:
+            aborted.set()                  # unblock fragmenter + feeder
+            await asyncio.gather(feed_task, frag_task,
+                                 return_exceptions=True)
+            raise
+        try:
+            # re-raises body errors (malformed chunked framing -> 400);
+            # nothing was finalized, so a truncated stream commits NO
+            # manifest — its already-placed chunks are unreferenced and
+            # the aged GC in the repair loop reclaims them
+            total = await feed_task
+        finally:
+            await frag_task
+        if stats["minCopies"] is None:     # zero-chunk (empty) stream
+            stats["minCopies"] = self.cfg.cluster.replication_factor
+        file_id = hasher.hexdigest()
+        if not name:
+            name = f"file-{file_id[:8]}"
+        manifest = Manifest(file_id=file_id, name=name, size=total,
+                            fragmenter=manifest.fragmenter,
+                            chunks=manifest.chunks)
+        stats["bytes"] = total
+        stats["uniqueChunks"] = len(seen)
+        await self._finalize_upload(manifest)
+        self.counters.inc("upload_bytes", total)
+        return manifest, stats
+
+    @staticmethod
+    def _new_upload_stats() -> dict:
+        return {"bytes": 0, "uniqueChunks": 0, "transferredBytes": 0,
+                "dedupSkippedBytes": 0, "minCopies": None,
+                "handoffChunks": 0, "degraded": False}
+
+    async def _place_batch(self, file_id: str,
+                           batch: list[tuple[str, bytes]],
+                           stats: dict) -> None:
+        """Place one batch of unique (digest, payload) chunks: local puts
+        for canonical ownership, concurrent replication with hash-echo
+        verification, then sloppy-quorum handoff — failing loudly if any
+        chunk ends below quorum. Shared by whole-payload upload (one
+        batch) and streaming upload (a batch per ~32 MiB)."""
         ids = self.cfg.cluster.sorted_ids()
         rf = self.cfg.cluster.replication_factor
 
-        # Group unique chunk payloads per target node.
         per_node: dict[int, list[tuple[str, bytes]]] = {}
         copies: dict[str, int] = {}
         payload_of: dict[str, bytes] = {}
-        for c in manifest.chunks:
-            if c.digest in payload_of:
-                continue  # duplicate content within the file: place once
-            copies[c.digest] = 0
-            # slice once; the same bytes object is shared across targets
-            payload = data[c.offset:c.offset + c.length]
-            payload_of[c.digest] = payload
-            for target in replica_set(c.digest, ids, rf):
+        for digest, payload in batch:
+            copies[digest] = 0
+            payload_of[digest] = payload
+            for target in replica_set(digest, ids, rf):
                 if target == self.cfg.node_id:
-                    if self.store.chunks.put(c.digest, payload, verify=False):
+                    if self.store.chunks.put(digest, payload, verify=False):
                         self.counters.inc("chunks_stored")
                         self.counters.inc("bytes_stored", len(payload))
                     else:
                         self.counters.inc("dedup_hits")
-                    copies[c.digest] += 1
+                    copies[digest] += 1
                 else:
-                    per_node.setdefault(target, []).append((c.digest, payload))
-
-        stats = {"bytes": len(data), "uniqueChunks": len(payload_of),
-                 "transferredBytes": 0, "dedupSkippedBytes": 0}
+                    per_node.setdefault(target, []).append((digest, payload))
 
         async def replicate(node_id: int,
                             wanted: list[tuple[str, bytes]]) -> None:
@@ -341,11 +498,14 @@ class StorageNodeServer:
         for d, n in copies.items():
             if n < rf or d in handoff:
                 self.under_replicated.add(d)
-        stats["minCopies"] = min(copies.values(), default=rf)
-        stats["handoffChunks"] = len(handoff)
-        stats["degraded"] = bool(
+        batch_min = min(copies.values(), default=rf)
+        stats["minCopies"] = batch_min if stats["minCopies"] is None \
+            else min(stats["minCopies"], batch_min)
+        stats["handoffChunks"] += len(handoff)
+        stats["degraded"] = stats["degraded"] or bool(
             handoff or any(n < rf for n in copies.values()))
 
+    async def _finalize_upload(self, manifest: Manifest) -> None:
         # Manifest-last ordering (SURVEY.md §5.4), then best-effort announce
         # (reference: announce failure only logged, StorageNode.java:338-346).
         # A fresh upload clears tombstones (locally and via fresh=True at
@@ -366,8 +526,6 @@ class StorageNodeServer:
 
         await asyncio.gather(*(announce(p) for p in self._peers()))
         self.counters.inc("uploads")
-        self.counters.inc("upload_bytes", len(data))
-        return manifest, stats
 
     # ------------------------------------------------------------------ #
     # download (L4) — reference handleDownload, StorageNode.java:399-461
@@ -405,8 +563,9 @@ class StorageNodeServer:
     _FETCH_BATCH_BYTES = 32 * 1024 * 1024
 
     async def _gather_chunks(self, manifest: Manifest | None,
-                             chunks=None,
-                             strict: bool = True) -> dict[str, bytes]:
+                             chunks=None, strict: bool = True,
+                             prefetched: dict[str, bytes] | None = None
+                             ) -> dict[str, bytes]:
         """Collect chunks (default: all of the manifest's): local first,
         then BATCHED remote fetches grouped by preferred replica holder
         (one RPC per ~32 MiB of chunks per peer — the per-chunk op costs
@@ -414,13 +573,17 @@ class StorageNodeServer:
         per-chunk replica-fallback path (:meth:`_fetch_chunk`) mopping up
         anything a peer turned out not to hold. Returns digest ->
         verified bytes; ``strict=False`` skips unrecoverable chunks
-        instead of raising (repair's best-effort restore)."""
+        instead of raising (repair's best-effort restore); ``prefetched``
+        carries bytes the caller already read+verified (skips the local
+        disk read)."""
         need: dict[str, int] = {}
         for c in (manifest.chunks if chunks is None else chunks):
             need.setdefault(c.digest, c.length)
         out: dict[str, bytes] = {}
         for d in list(need):
-            b = self.store.chunks.get(d)
+            b = (prefetched or {}).get(d)
+            if b is None:
+                b = self.store.chunks.get(d)
             if b is not None:
                 out[d] = b
                 del need[d]
@@ -563,14 +726,27 @@ class StorageNodeServer:
 
         wanted = [c for c in manifest.chunks
                   if c.offset < end and c.offset + c.length > start]
-        for c in wanted:
-            b = self.store.chunks.get(c.digest)
-            if b is not None and sha256_hex(b) != c.digest:
-                self.store.chunks.delete(c.digest)
-                self.under_replicated.add(c.digest)
+        # verify local copies ONCE, off the event loop, and hand the
+        # verified bytes to the gather (reading + hashing them inline and
+        # re-reading in the gather would double the disk I/O and stall
+        # every other request for the duration of a big range)
+        digests = list(dict.fromkeys(c.digest for c in wanted))
+        local = await asyncio.to_thread(
+            lambda: [(d, b) for d in digests
+                     if (b := self.store.chunks.get(d)) is not None])
+        hexes = await asyncio.to_thread(
+            sha256_many_hex, [b for _, b in local])
+        good: dict[str, bytes] = {}
+        for (d, b), h in zip(local, hexes):
+            if h == d:
+                good[d] = b
+            else:
+                self.store.chunks.delete(d)
+                self.under_replicated.add(d)
                 self.log.warning("evicted corrupt local chunk %s on "
-                                 "range read", c.digest[:12])
-        by_digest = await self._gather_chunks(manifest, chunks=wanted)
+                                 "range read", d[:12])
+        by_digest = await self._gather_chunks(manifest, chunks=wanted,
+                                              prefetched=good)
         parts = []
         for c in wanted:
             b = by_digest[c.digest]
@@ -750,6 +926,13 @@ class StorageNodeServer:
                 continue
         # only drop repair entries we actually confirmed on a peer
         self.under_replicated -= verified
+        # aged orphan sweep: chunks of aborted streaming uploads (placed
+        # before their manifest existed, then never committed) have no
+        # other reclamation path; the 1h grace keeps in-flight uploads
+        # safe (manifest-last ordering makes their chunks look orphaned)
+        swept = self.store.gc(min_age_s=3600.0)
+        if swept:
+            self.log.info("gc: swept %d aged orphan chunks", len(swept))
         return repaired
 
     async def scrub_once(self) -> dict:

@@ -211,13 +211,31 @@ class NodeStore:
         self.chunks = ChunkStore(self.root / "chunks")
         self.manifests = ManifestStore(self.root / "manifests")
 
-    def gc(self) -> list[str]:
+    def gc(self, min_age_s: float = 0.0) -> list[str]:
         """Delete chunks referenced by no manifest (the reference has no
-        delete/GC at all — SURVEY.md §2.5(5)). Returns deleted digests."""
+        delete/GC at all — SURVEY.md §2.5(5)). Returns deleted digests.
+
+        ``min_age_s`` spares recently-written chunks: uploads are
+        manifest-LAST, so an in-flight upload's chunks are unreferenced
+        until it commits — the periodic orphan sweep (repair loop) passes
+        a generous age so it only reclaims chunks from genuinely
+        abandoned streams (aborted chunked uploads), never from a live
+        one. Delete-triggered GC keeps age 0: explicit user intent."""
         live: set[str] = set()
         for m in self.manifests.list():
             live.update(m.digests())
-        dead = [d for d in self.chunks.digests() if d not in live]
+        cutoff = time.time() - min_age_s
+        dead = []
+        for d in self.chunks.digests():
+            if d in live:
+                continue
+            if min_age_s > 0:
+                try:
+                    if self.chunks._path(d).stat().st_mtime > cutoff:
+                        continue
+                except FileNotFoundError:
+                    continue
+            dead.append(d)
         for d in dead:
             self.chunks.delete(d)
         return dead

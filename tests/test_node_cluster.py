@@ -325,6 +325,64 @@ def test_delete_survives_node_downtime(tmp_path, rng):
     asyncio.run(run())
 
 
+def test_streaming_upload_matches_regular(tmp_path, rng):
+    """Chunked-transfer upload must produce the same file id and chunk
+    table as a whole-body upload of identical bytes, be visible
+    cluster-wide, and round-trip byte-identical — with the body flowing
+    through the bounded-memory pipeline (multiple placement flushes are
+    exercised separately; here parity is the contract)."""
+    data = rng.integers(0, 256, size=200_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path)
+        c1 = NodeClient(port=cluster.peer(1).port)
+        c2 = NodeClient(port=cluster.peer(2).port)
+        try:
+            blocks = [data[i:i + 7000] for i in range(0, len(data), 7000)]
+            info = await asyncio.to_thread(
+                c1.upload_stream, blocks, "streamed.bin")
+            assert info["bytes"] == len(data)
+            # same content uploaded whole elsewhere -> same fileId
+            info2 = await asyncio.to_thread(c2.upload, data, "streamed.bin")
+            assert info2["fileId"] == info["fileId"]
+            assert info2["chunks"] == info["chunks"]
+            got = await asyncio.to_thread(c2.download, info["fileId"])
+            assert got == data
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_streaming_upload_multiflush(tmp_path, rng):
+    """A stream larger than the placement flush threshold places chunks
+    in multiple batches mid-stream; quorum stats aggregate across
+    batches and the result round-trips."""
+    data = rng.integers(0, 256, size=300_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            nodes[1]._STREAM_FLUSH_BYTES = 50_000   # force several flushes
+
+            async def blocks():
+                for i in range(0, len(data), 9000):
+                    yield data[i:i + 9000]
+
+            manifest, stats = await nodes[1].upload_stream(
+                blocks(), "big-stream.bin")
+            assert stats["bytes"] == len(data)
+            assert stats["minCopies"] >= 2
+            _, got = await nodes[2].download(manifest.file_id)
+            assert got == data
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
 def test_range_download(tmp_path, rng):
     """HTTP Range requests: chunk-granular partial reads, byte-exact at
     arbitrary unaligned offsets; suffix and open ranges; 416 past EOF.

@@ -111,10 +111,10 @@ def main() -> int:
     assert spans[1][2] == want, "resident-path digest mismatch vs hashlib"
     log(f"resident warm: {len(spans)} chunks in one region")
 
-    # best of three slope estimates: the harness device link is shared, so
+    # best of five slope estimates: the harness device link is shared, so
     # single runs see ±40% interference; min measures chip capability
     dts = []
-    for _ in range(3):
+    for _ in range(5):
         times = []
         for k in (1, passes):
             t0 = time.perf_counter()

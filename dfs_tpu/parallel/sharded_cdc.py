@@ -266,6 +266,49 @@ def shard_anchored_inputs(mesh: Mesh, words: np.ndarray, w_off: np.ndarray,
     )
 
 
+def host_lane_descriptors(data: np.ndarray, params, pad_multiple: int):
+    """Host-side segment selection + pass-B lane descriptor encoding for
+    a whole stream — ONE implementation of the w_off/sh8/real_blocks
+    layout (it must stay bit-identical to the device-side
+    make_descriptor_fn), shared by the dryrun parity check and the
+    multihost test worker. Returns (starts, bounds, seg_lens, w_off, sh8,
+    real_blocks, s_real)."""
+    from dfs_tpu.ops.cdc_anchored import kept_anchors_np, select_segments
+    from dfs_tpu.ops.cdc_v2 import BLOCK
+
+    n = int(data.shape[0])
+    bounds = select_segments(kept_anchors_np(data, params), n, params)
+    starts = np.concatenate([[0], bounds[:-1]])
+    seg_lens = bounds - starts
+    s_real = starts.shape[0]
+    s_pad = -(-s_real // pad_multiple) * pad_multiple
+    w_off = np.zeros((s_pad,), np.int32)
+    sh8 = np.zeros((s_pad,), np.uint32)
+    real_blocks = np.zeros((s_pad,), np.int32)
+    w_off[:s_real] = starts // 4 + 2       # +2: the 8 lookback bytes
+    sh8[:s_real] = (starts % 4) * 8
+    real_blocks[:s_real] = -(-seg_lens // BLOCK)
+    return starts, bounds, seg_lens, w_off, sh8, real_blocks, s_real
+
+
+def expected_segment_cutflags(data: np.ndarray, starts, bounds,
+                              params) -> np.ndarray:
+    """Per-segment oracle cutflags [bps, s_real] for pass-B verification
+    (NumPy candidates + greedy selection per segment)."""
+    from dfs_tpu.ops.cdc_v2 import BLOCK, candidates_np, select_cuts_blocks
+
+    bps = params.chunk.strip_blocks
+    s_real = len(starts)
+    out = np.zeros((bps, s_real), np.int32)
+    for i in range(s_real):
+        seg = data[int(starts[i]):int(bounds[i])]
+        nb = -(-seg.shape[0] // BLOCK)
+        pos = np.flatnonzero(candidates_np(seg, params.chunk))
+        cuts = select_cuts_blocks(pos, nb, params.chunk)
+        out[cuts - 1, i] = 1
+    return out
+
+
 def anchored_sharded_parity_check(mesh: Mesh, n_devices: int) -> None:
     """Run both sharded anchored passes on a tiny stream and assert parity
     with the NumPy oracles — shared by the driver's multichip dryrun
@@ -275,10 +318,8 @@ def anchored_sharded_parity_check(mesh: Mesh, n_devices: int) -> None:
     spans == whole-stream chunk_spans_anchored_np)."""
     from dfs_tpu.ops.cdc_anchored import (TILE_BYTES, AnchoredCdcParams,
                                           chunk_spans_anchored_np,
-                                          kept_anchors_np, region_buffer,
-                                          select_segments)
-    from dfs_tpu.ops.cdc_v2 import BLOCK, AlignedCdcParams, candidates_np, \
-        select_cuts_blocks
+                                          kept_anchors_np, region_buffer)
+    from dfs_tpu.ops.cdc_v2 import BLOCK, AlignedCdcParams
 
     params = AnchoredCdcParams(
         chunk=AlignedCdcParams(min_blocks=2, avg_blocks=4, max_blocks=16,
@@ -304,34 +345,17 @@ def anchored_sharded_parity_check(mesh: Mesh, n_devices: int) -> None:
         raise AssertionError("sharded anchored pass A tile mismatch")
 
     # ---- host segment selection (metadata-sized, shared with oracle) ----
-    bounds = select_segments(kept, n, params)
-    starts = np.concatenate([[0], bounds[:-1]])
-    seg_lens = bounds - starts
-    s_real = starts.shape[0]
-    s_pad = -(-s_real // n_devices) * n_devices
-    w_off = np.zeros((s_pad,), np.int32)
-    sh8 = np.zeros((s_pad,), np.uint32)
-    real_blocks = np.zeros((s_pad,), np.int32)
-    w_off[:s_real] = starts // 4 + 2
-    sh8[:s_real] = (starts % 4) * 8
-    real_blocks[:s_real] = -(-seg_lens // BLOCK)
+    (starts, bounds, seg_lens, w_off, sh8, real_blocks,
+     s_real) = host_lane_descriptors(data, params, n_devices)
 
     # ---- pass B sharded: per-segment cutflags vs oracle ----
     bstep = make_anchored_step(mesh, params)
     cf, since, _states, n_chunks = bstep(*shard_anchored_inputs(
         mesh, words, w_off, sh8, real_blocks))
     cf = np.asarray(cf)
-    bps = params.chunk.strip_blocks
-    for i in range(s_real):
-        seg = data[starts[i]:bounds[i]]
-        nb = -(-seg.shape[0] // BLOCK)
-        pos = np.flatnonzero(candidates_np(seg, params.chunk))
-        cuts = select_cuts_blocks(pos, nb, params.chunk)
-        expect = np.zeros((bps,), np.int32)
-        expect[cuts - 1] = 1
-        if not np.array_equal(cf[:, i], expect):
-            raise AssertionError(
-                f"anchored sharded cutflag mismatch, segment {i}")
+    expect = expected_segment_cutflags(data, starts, bounds, params)
+    if not np.array_equal(cf[:, :s_real], expect):
+        raise AssertionError("anchored sharded cutflag mismatch")
     if int(n_chunks) != int(cf.sum()):
         raise AssertionError("anchored psum chunk count mismatch")
 

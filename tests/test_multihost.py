@@ -69,6 +69,43 @@ for shard in bitmap.addressable_shards:
     want, _ = gear_bitmap_carry(data[row, lo:rs.stop], table, params.mask, prev)
     ok &= bool(np.array_equal(np.asarray(shard.data)[0], want))
 print(f"WORKER{pid}-{'OK' if ok else 'MISMATCH'}", flush=True)
+
+# ---- anchored flagship pass B over the same global mesh: segment lanes
+# shard across processes (zero halo); each process verifies its
+# addressable lane shards against the per-segment oracle (descriptor
+# encoding + oracle come from the SAME shared helpers the dryrun uses) ----
+from dfs_tpu.ops.cdc_anchored import AnchoredCdcParams, region_buffer
+from dfs_tpu.ops.cdc_v2 import AlignedCdcParams
+from dfs_tpu.parallel.sharded_cdc import (expected_segment_cutflags,
+                                          host_lane_descriptors,
+                                          make_anchored_step)
+
+aparams = AnchoredCdcParams(
+    chunk=AlignedCdcParams(min_blocks=2, avg_blocks=4, max_blocks=16,
+                           strip_blocks=64),
+    seg_min=2048, seg_max=4096, seg_mask=2047)
+n = 64 * 1024
+adata = np.random.default_rng(77).integers(0, 256, size=n, dtype=np.uint8)
+awords = np.asarray(region_buffer(adata, np.zeros((8,), np.uint8), aparams))
+starts, bounds, seg_lens, w_off, sh8, rb, s_real = host_lane_descriptors(
+    adata, aparams, info["global_devices"])
+expect = expected_segment_cutflags(adata, starts, bounds, aparams)
+
+bstep = make_anchored_step(mesh, aparams)
+cf, since, states, n_chunks = bstep(
+    dist(awords, P()), dist(w_off, P(("dp", "sp"))),
+    dist(sh8, P(("dp", "sp"))), dist(rb, P(("dp", "sp"))))
+aok = True
+for shard in cf.addressable_shards:
+    cols = shard.index[1]
+    local = np.asarray(shard.data)
+    for j, lane in enumerate(range(cols.start or 0, cols.stop)):
+        if lane >= s_real:
+            aok &= not local[:, j].any()
+        else:
+            aok &= bool(np.array_equal(local[:, j], expect[:, lane]))
+aok &= int(n_chunks) > 0
+print(f"ANCHORED{pid}-{'OK' if aok else 'MISMATCH'}", flush=True)
 """
 
 
@@ -105,3 +142,4 @@ def test_two_process_global_mesh(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"WORKER{pid}-OK" in out, f"worker {pid} output:\n{out}"
+        assert f"ANCHORED{pid}-OK" in out, f"worker {pid} output:\n{out}"

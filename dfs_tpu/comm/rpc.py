@@ -121,9 +121,13 @@ class InternalClient:
             peer, {"op": "get_chunks", "digests": digests})
         return unpack_chunks(resp.get("chunks", []), body)
 
-    async def get_manifest(self, peer: PeerAddr, file_id: str) -> str | None:
+    async def get_manifest(self, peer: PeerAddr, file_id: str
+                           ) -> tuple[str | None, float | None]:
+        """-> (manifest json or None, origin mtime or None). The mtime is
+        the peer's on-disk write time — adopters must preserve it (LWW
+        against tombstones)."""
         resp, _ = await self.call(peer, {"op": "get_manifest", "fileId": file_id})
-        return resp.get("manifest")
+        return resp.get("manifest"), resp.get("mtime")
 
     async def health(self, peer: PeerAddr) -> dict[str, Any]:
         resp, _ = await self.call(peer, {"op": "health"})

@@ -172,6 +172,8 @@ class StorageNodeServer:
             return {"ok": True,
                     "tombs": [{"id": fid, "ts": ms.tombstone_ts(fid)}
                               for fid in ms.tombstones()]}, b""
+        if op == "list_manifests":
+            return {"ok": True, "ids": self.store.manifests.ids()}, b""
         if op == "get_chunk":
             data = self.store.chunks.get(header["digest"])
             if data is None:
@@ -189,7 +191,9 @@ class StorageNodeServer:
         if op == "get_manifest":
             m = self.store.manifests.load(header["fileId"])
             return {"ok": True,
-                    "manifest": None if m is None else m.to_json()}, b""
+                    "manifest": None if m is None else m.to_json(),
+                    "mtime": self.store.manifests.mtime(
+                        header["fileId"])}, b""
         if op == "delete":
             self.store.manifests.delete(header["fileId"])
             self.store.gc()
@@ -209,13 +213,17 @@ class StorageNodeServer:
                 if p.node_id != self.cfg.node_id]
 
     async def upload(self, data: bytes, name: str) -> tuple[Manifest, dict]:
+        # hashing + fragmentation run off the event loop: a multi-hundred-
+        # MiB body would otherwise stall every concurrent request for the
+        # full CPU pass (the reference is thread-per-connection so it
+        # never noticed; an asyncio node must not block its loop)
         with span("upload.hash_file", self.latency):
-            file_id = sha256_hex(data)
+            file_id = await asyncio.to_thread(sha256_hex, data)
         if not name:
             name = f"file-{file_id[:8]}"  # reference default, StorageNode.java:133-135
         with span("upload.fragment", self.latency):
-            manifest = self.fragmenter.manifest(data, name=name,
-                                                file_id=file_id)
+            manifest = await asyncio.to_thread(
+                self.fragmenter.manifest, data, name=name, file_id=file_id)
 
         stats = self._new_upload_stats()
         stats["bytes"] = len(data)
@@ -682,15 +690,18 @@ class StorageNodeServer:
             raise NotFoundError(file_id)
         if manifest is None:
             # Manifest fallback from peers — fixes the reference's silent
-            # manifest loss on nodes that were down during announce (§5.3).
+            # manifest loss on nodes that were down during announce
+            # (§5.3). Adoption preserves the ORIGIN mtime: stamping now
+            # would make a stale adopted manifest postdate a legitimate
+            # delete in the tombstone LWW comparison.
             for peer in self._peers():
                 try:
-                    mj = await self.client.get_manifest(peer, file_id)
+                    mj, mt = await self.client.get_manifest(peer, file_id)
                 except RpcError:
                     continue
                 if mj:
                     manifest = Manifest.from_json(mj)
-                    self.store.manifests.save(manifest)
+                    self.store.manifests.save(manifest, mtime=mt)
                     break
         if manifest is None:
             raise NotFoundError(file_id)
@@ -763,8 +774,9 @@ class StorageNodeServer:
             by_digest = await self._gather_chunks(manifest)
         data = b"".join(by_digest[c.digest] for c in manifest.chunks)
         # Whole-file integrity gate, exactly the reference's
-        # sha256(assembled) == fileId check (StorageNode.java:453-458).
-        if sha256_hex(data) != file_id:
+        # sha256(assembled) == fileId check (StorageNode.java:453-458) —
+        # hashed off the event loop (big files would stall other requests)
+        if await asyncio.to_thread(sha256_hex, data) != file_id:
             raise DownloadError("File corrupted")
         self.counters.inc("downloads")
         self.counters.inc("download_bytes", len(data))
@@ -855,6 +867,45 @@ class StorageNodeServer:
             self.log.info("anti-entropy: applied %d tombstones", applied)
         return applied
 
+    async def _manifest_antientropy(self) -> int:
+        """Pull manifests this node is missing (announce is best-effort,
+        exactly like the reference — StorageNode.java:338-346 — so a node
+        that was down or timed out during an announce would otherwise
+        stay silently ignorant of the file forever, SURVEY §3.4's noted
+        hole). Tombstoned ids are skipped: deletes win over stale
+        creates; the LWW path handles the re-upload case. Returns
+        #manifests adopted."""
+        known = set(self.store.manifests.ids())
+        adopted = 0
+        for peer in self._peers():
+            try:
+                resp, _ = await self.client.call(
+                    peer, {"op": "list_manifests"}, retries=1)
+                self.health.mark_alive(peer.node_id)
+            except RpcError:
+                continue
+            for fid in resp.get("ids", []):
+                if (fid in known or not is_hex_digest(fid)
+                        or self.store.manifests.is_tombstoned(fid)):
+                    continue
+                try:
+                    mj, mt = await self.client.get_manifest(peer, fid)
+                except RpcError:
+                    continue
+                if mj:
+                    try:
+                        m = Manifest.from_json(mj)
+                    except (ValueError, KeyError):
+                        continue          # corrupt peer manifest
+                    # adoption preserves the ORIGIN mtime — see save()
+                    if m.file_id == fid and self.store.manifests.save(
+                            m, mtime=mt):
+                        known.add(fid)
+                        adopted += 1
+        if adopted:
+            self.log.info("anti-entropy: adopted %d manifests", adopted)
+        return adopted
+
     async def repair_once(self) -> int:
         """Re-replicate chunks below replication factor. Walks every local
         manifest; for chunks whose replica set includes peers missing the
@@ -862,8 +913,11 @@ class StorageNodeServer:
 
         Tombstone anti-entropy runs FIRST: repairing from a manifest whose
         file was deleted cluster-wide while this node slept would push the
-        deleted chunks back onto peers."""
+        deleted chunks back onto peers. Manifest anti-entropy runs second
+        (adopt creates this node missed), so the repair walk below also
+        restores this node's canonical chunks for newly-adopted files."""
         await self._tombstone_antientropy()
+        await self._manifest_antientropy()
         ids = self.cfg.cluster.sorted_ids()
         rf = self.cfg.cluster.replication_factor
         need: dict[int, list[tuple[str, int]]] = {}

@@ -135,13 +135,28 @@ class ManifestStore:
         return sorted(p.stem for p in self.root.glob("*.tomb")
                       if is_hex_digest(p.stem))
 
-    def save(self, m: Manifest) -> bool:
+    def save(self, m: Manifest, mtime: float | None = None) -> bool:
         """Persist a manifest; refused (False) when the file is
-        tombstoned, so late announces cannot resurrect a deleted file."""
+        tombstoned, so late announces cannot resurrect a deleted file.
+
+        ``mtime`` carries the ORIGIN write time when a manifest is being
+        ADOPTED from a peer (anti-entropy / download fallback): the file
+        mtime is the LWW ordering side against tombstone timestamps, and
+        stamping adoption time instead would make an adopted stale
+        manifest look newer than a legitimate delete."""
         if self.is_tombstoned(m.file_id):
             return False
-        _atomic_write(self._path(m.file_id), m.to_json().encode())
+        p = self._path(m.file_id)
+        _atomic_write(p, m.to_json().encode())
+        if mtime is not None:
+            os.utime(p, (mtime, mtime))
         return True
+
+    def ids(self) -> list[str]:
+        """File ids present, from filenames alone — no reads/parses (the
+        anti-entropy exchange runs every repair cycle on every node)."""
+        return sorted(p.stem for p in self.root.glob("*.json")
+                      if is_hex_digest(p.stem))
 
     def load(self, file_id: str) -> Manifest | None:
         try:

@@ -280,6 +280,38 @@ def test_http_api_roundtrip(tmp_path, rng):
     asyncio.run(run())
 
 
+def test_manifest_antientropy_adopts_missed_creates(tmp_path, rng):
+    """A node that slept through an upload's announce adopts the manifest
+    on its next repair (the reference leaves it silently ignorant
+    forever, SURVEY §3.4) AND restores its own canonical chunks."""
+    data = rng.integers(0, 256, size=50_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path, ids={1, 2},
+                                  retries=1, connect_timeout_s=0.3)
+        try:
+            manifest, _ = await nodes[1].upload(data, "missed.bin")
+            nodes.update(await start_nodes(cluster, tmp_path, ids={3},
+                                           retries=1, connect_timeout_s=0.3))
+            assert nodes[3].store.manifests.load(manifest.file_id) is None
+            await nodes[3].repair_once()
+            assert nodes[3].store.manifests.load(manifest.file_id) \
+                is not None
+            # canonical chunks of the adopted file now live on node 3 too
+            from dfs_tpu.node.placement import replica_set
+            ids = cluster.sorted_ids()
+            for c in manifest.chunks:
+                if 3 in replica_set(c.digest, ids, 2):
+                    assert nodes[3].store.chunks.has(c.digest)
+            _, got = await nodes[3].download(manifest.file_id)
+            assert got == data
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
 def test_delete_survives_node_downtime(tmp_path, rng):
     """Delete while one node is down; when it returns, anti-entropy (run
     before re-replication in repair_once) applies the tombstone: the file

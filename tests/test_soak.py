@@ -1,0 +1,111 @@
+"""Full-lifecycle soak: one 5-node cluster exercises every capability in
+sequence at modest scale — the closest in-tree analogue of BASELINE.json
+config 5 (the 50 GiB 1-failure reconstruction, scaled).
+
+Marked slow; run explicitly with `pytest -m slow tests/test_soak.py`.
+The default suite still covers each feature individually.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from tests.test_node_cluster import (make_cluster_cfg, start_nodes,
+                                     stop_nodes)
+
+
+@pytest.mark.slow
+def test_full_lifecycle_soak(tmp_path, rng):
+    total = 8 * 1024 * 1024
+    n_files = 6
+
+    async def run():
+        cluster = make_cluster_cfg(5)
+        nodes = await start_nodes(cluster, tmp_path,
+                                  retries=1, connect_timeout_s=0.3)
+        try:
+            # 1. mixed ingest: whole-body and streaming uploads
+            files = {}
+            for i in range(n_files):
+                data = rng.integers(0, 256, size=total // n_files,
+                                    dtype=np.uint8).tobytes()
+                if i % 2:
+                    async def blocks(d=data):
+                        for j in range(0, len(d), 65536):
+                            yield d[j:j + 65536]
+                    m, _ = await nodes[1 + i % 5].upload_stream(
+                        blocks(), f"f{i}.bin")
+                else:
+                    m, _ = await nodes[1 + i % 5].upload(data, f"f{i}.bin")
+                files[m.file_id] = data
+
+            # 2. every node lists every file (announce is best-effort;
+            # manifest anti-entropy in repair converges any missed one)
+            for n in nodes.values():
+                await n.repair_once()
+            for n in nodes.values():
+                assert len(n.list_files()) == n_files
+
+            # 3. ranges from arbitrary nodes
+            for fid, data in list(files.items())[:3]:
+                _, part, s, e = await nodes[3].download_range(
+                    fid, 1000, 50_000)
+                assert part == data[s:e]
+
+            # 4. corrupt one chunk somewhere, scrub, repair
+            fid0, data0 = next(iter(files.items()))
+            m0 = nodes[2].store.manifests.load(fid0)
+            victim = m0.chunks[0].digest
+            holder = next(n for n in nodes.values()
+                          if n.store.chunks.has(victim))
+            p = holder.store.chunks._path(victim)
+            raw = bytearray(p.read_bytes())
+            raw[-1] ^= 0x5A
+            p.write_bytes(bytes(raw))
+            res = await holder.scrub_once()
+            assert res["corrupt"] == 1
+            await holder.repair_once()
+            assert holder.store.chunks.has(victim)
+
+            # 5. kill one node; everything still reads byte-identical
+            await nodes.pop(5).stop()
+            for fid, data in files.items():
+                _, got = await nodes[1].download(fid)
+                assert got == data
+
+            # 6. delete one file while the node is down; restart; converge
+            del_fid = sorted(files)[0]
+            assert await nodes[2].delete(del_fid)
+            nodes.update(await start_nodes(cluster, tmp_path, ids={5},
+                                           retries=1, connect_timeout_s=0.3))
+            await nodes[5].repair_once()
+            assert nodes[5].store.manifests.load(del_fid) is None
+            for n in nodes.values():
+                names = {f["fileId"] for f in n.list_files()}
+                assert del_fid not in names
+
+            # 7. repair restores full replication after the outage
+            for n in nodes.values():
+                await n.repair_once()
+            from dfs_tpu.node.placement import replica_set
+            ids = cluster.sorted_ids()
+            for fid, data in files.items():
+                if fid == del_fid:
+                    continue
+                m = nodes[1].store.manifests.load(fid)
+                for c in m.chunks:
+                    for t in replica_set(c.digest, ids, 2):
+                        assert nodes[t].store.chunks.has(c.digest), \
+                            f"{c.digest[:8]} missing on {t}"
+
+            # 8. remaining files still byte-identical from the rejoined node
+            for fid, data in files.items():
+                if fid == del_fid:
+                    continue
+                _, got = await nodes[5].download(fid)
+                assert got == data
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())

@@ -49,17 +49,38 @@ def cmd_serve(args) -> int:
     async def run() -> None:
         node = StorageNodeServer(cfg)
         await node.start()
-        if args.repair_interval > 0:
-            async def repair_loop() -> None:
+        # strong refs: the event loop holds only weak task references, so
+        # an unreferenced background task can be GC'd and silently
+        # cancelled mid-sleep
+        tasks: list[asyncio.Task] = []
+
+        def periodic(interval: float, what: str, fn) -> None:
+            if interval <= 0:
+                return
+
+            async def loop() -> None:
                 while True:
-                    await asyncio.sleep(args.repair_interval)
+                    await asyncio.sleep(interval)
                     try:
-                        n = await node.repair_once()
-                        if n:
-                            node.log.info("repair: re-replicated %d chunks", n)
+                        await fn()
                     except Exception as e:  # noqa: BLE001
-                        node.log.warning("repair failed: %s", e)
-            asyncio.create_task(repair_loop())
+                        node.log.warning("%s failed: %s", what, e)
+
+            tasks.append(asyncio.create_task(loop()))
+
+        async def do_repair() -> None:
+            n = await node.repair_once()
+            if n:
+                node.log.info("repair: re-replicated %d chunks", n)
+
+        async def do_scrub() -> None:
+            res = await node.scrub_once()
+            if res["corrupt"]:
+                node.log.warning("scrub: %d corrupt chunks evicted",
+                                 res["corrupt"])
+
+        periodic(args.repair_interval, "repair", do_repair)
+        periodic(args.scrub_interval, "scrub", do_scrub)
         await asyncio.Event().wait()  # serve forever
 
     try:
@@ -229,6 +250,9 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--avg-chunk", type=int, default=8192)
     serve.add_argument("--max-chunk", type=int, default=65536)
     serve.add_argument("--repair-interval", type=float, default=30.0)
+    serve.add_argument("--scrub-interval", type=float, default=3600.0,
+                       help="seconds between local integrity sweeps "
+                            "(re-hash every chunk; 0 disables)")
     serve.add_argument("--sidecar-port", type=int, default=None,
                        help="delegate chunk+hash to a running sidecar "
                             "process (overrides --fragmenter)")

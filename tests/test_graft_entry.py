@@ -13,30 +13,37 @@ import __graft_entry__  # noqa: E402
 
 
 def test_entry_compiles_and_runs():
+    """entry() is the flagship anchored chain: jit-compile it whole and
+    check the produced chunk table against the whole-stream oracle."""
+    import hashlib
+
+    from dfs_tpu.ops.cdc_anchored import AnchoredCdcParams
+    from dfs_tpu.ops.cdc_pipeline import digests_to_hex
+    from dfs_tpu.ops.cdc_v2 import AlignedCdcParams
+    from dfs_tpu.ops.cdc_anchored import chunk_file_anchored_np
+
     fn, args = __graft_entry__.entry()
     jitted = jax.jit(fn)
-    cf32, states = jitted(*args)
-    words_le, real_blocks = args
-    s = words_le.shape[0]
-    bps = real_blocks[0]
-    assert cf32.shape == (bps, s)
-    assert states.shape == (bps * 8, s)
+    consumed, count, q, offs, lens, dig = jitted(*args)
+    count = int(np.asarray(count))
+    assert count > 0
+    assert int(np.asarray(consumed)) == 128 * 1024   # final region
+    offs = np.asarray(offs)[:count]
+    lens = np.asarray(lens)[:count]
+    hexes = digests_to_hex(np.asarray(dig)[:count])
 
-    # cutflag must match the NumPy oracle on the recovered raw stream
-    from dfs_tpu.ops.cdc_v2 import (AlignedCdcParams, candidates_np,
-                                    select_cuts_blocks)
-    params = AlignedCdcParams(min_blocks=8, avg_blocks=32, max_blocks=128,
-                              strip_blocks=256)  # mirrors entry()
-    raw = np.ascontiguousarray(words_le).view(np.uint8)
-    cand = candidates_np(raw.reshape(-1), params)
-    cf = np.asarray(cf32)
-    for i in range(s):
-        pos = np.flatnonzero(
-            cand[i * params.strip_blocks:(i + 1) * params.strip_blocks])
-        cuts = select_cuts_blocks(pos, params.strip_blocks, params)
-        expect = np.zeros((params.strip_blocks,), np.int32)
-        expect[cuts - 1] = 1
-        assert np.array_equal(cf[:, i], expect), f"strip {i}"
+    params = AnchoredCdcParams(
+        chunk=AlignedCdcParams(min_blocks=2, avg_blocks=4, max_blocks=16,
+                               strip_blocks=64),
+        seg_min=2048, seg_max=4096, seg_mask=2047)   # mirrors entry()
+    words, _start0 = args
+    n = 128 * 1024
+    data = np.ascontiguousarray(words).view(np.uint8)[8:8 + n]
+    want = chunk_file_anchored_np(data, params)
+    got = sorted(zip(offs.tolist(), lens.tolist(), hexes))
+    assert got == sorted(want)
+    o, ln, dg = got[0]
+    assert dg == hashlib.sha256(data[o:o + ln].tobytes()).hexdigest()
 
 
 def test_dryrun_multichip_8():

@@ -280,6 +280,42 @@ def test_http_api_roundtrip(tmp_path, rng):
     asyncio.run(run())
 
 
+def test_cluster_with_anchored_device_pipeline(tmp_path, rng):
+    """Upload through the anchored DEVICE pipeline (the flagship the
+    'auto' default picks on TPU hosts; here it runs on the CPU backend
+    with tiny lanes) inside a real cluster: region walk, placement,
+    replication, cross-node download — byte identical, and the manifest
+    matches what the CPU oracle fragmenter produces for the same bytes."""
+    from dfs_tpu.fragmenter.cdc_anchored import (AnchoredCpuFragmenter,
+                                                 AnchoredTpuFragmenter)
+    from dfs_tpu.ops.cdc_anchored import AnchoredCdcParams
+    from dfs_tpu.ops.cdc_v2 import AlignedCdcParams
+
+    small = AnchoredCdcParams(
+        chunk=AlignedCdcParams(min_blocks=2, avg_blocks=4, max_blocks=16,
+                               strip_blocks=64),
+        seg_min=2048, seg_max=4096, seg_mask=2047)
+    data = rng.integers(0, 256, size=150_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            nodes[1].fragmenter = AnchoredTpuFragmenter(
+                small, region_bytes=16384, cpu_cutoff=0, lane_multiple=8)
+            manifest, _ = await nodes[1].upload(data, "device.bin")
+            _, got = await nodes[2].download(manifest.file_id)
+            assert got == data
+            cpu = AnchoredCpuFragmenter(small).chunk(data)
+            assert [(c.offset, c.length, c.digest)
+                    for c in manifest.chunks] == \
+                [(c.offset, c.length, c.digest) for c in cpu]
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
 def test_manifest_antientropy_adopts_missed_creates(tmp_path, rng):
     """A node that slept through an upload's announce adopts the manifest
     on its next repair (the reference leaves it silently ignorant

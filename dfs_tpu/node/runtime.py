@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import asyncio
 import math
-from typing import Iterable
 
 from dfs_tpu.comm.rpc import InternalClient, RpcError, RpcUnreachable
 from dfs_tpu.comm.wire import (WireError, pack_chunks, read_msg, send_msg,

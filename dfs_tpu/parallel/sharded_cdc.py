@@ -23,8 +23,6 @@ is one SPMD program with XLA collectives on ICI.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np

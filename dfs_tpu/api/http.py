@@ -81,11 +81,13 @@ def _parse_range(value: str) -> tuple[int | None, int | None] | None:
     first, _, last = spec.partition("-")
     if _ != "-" or (not first and not last):
         return None
-    try:
-        return (int(first) if first else None,
-                int(last) if last else None)
-    except ValueError:
+    # digits only (RFC 9110: first-byte-pos / suffix-length = 1*DIGIT) —
+    # int() would accept signs, turning 'bytes=--5' into a bogus negative
+    # suffix that read as satisfiability instead of malformed syntax
+    if (first and not first.isdigit()) or (last and not last.isdigit()):
         return None
+    return (int(first) if first else None,
+            int(last) if last else None)
 
 
 async def _chunked_body(reader: asyncio.StreamReader, limit: int = MAX_BODY):
@@ -243,16 +245,24 @@ async def _serve_one(node: "StorageNodeServer",
             return plain(400, "Missing fileId")
         if _bad_id(file_id):
             return plain(400, "Bad fileId")
+        rng = None
+        if range_header is not None:
+            # partial read: chunk-granular manifests make byte ranges
+            # cheap (only overlapping chunks are gathered) — surface
+            # the reference never had (no range requests anywhere,
+            # SURVEY.md §2.5(5)); satisfiability is resolved in ONE
+            # place (download_range), this layer only parses/formats
+            rng = _parse_range(range_header)
+            if rng is None:
+                return plain(400, "Bad Range")
+            if (rng[0] is not None and rng[1] is not None
+                    and rng[0] > rng[1]):
+                # 'bytes=5-2' is syntactically invalid per RFC 9110
+                # §14.1.1: the Range header MUST be ignored (full 200
+                # body), not answered 416.
+                rng = None
         try:
-            if range_header is not None:
-                # partial read: chunk-granular manifests make byte ranges
-                # cheap (only overlapping chunks are gathered) — surface
-                # the reference never had (no range requests anywhere,
-                # SURVEY.md §2.5(5)); satisfiability is resolved in ONE
-                # place (download_range), this layer only parses/formats
-                rng = _parse_range(range_header)
-                if rng is None:
-                    return plain(400, "Bad Range")
+            if rng is not None:
                 try:
                     manifest, data, start, end = await node.download_range(
                         file_id, *rng)

@@ -87,6 +87,7 @@ class StorageNodeServer:
         self.under_replicated: set[str] = set()  # digests needing repair
         self._internal_server: asyncio.AbstractServer | None = None
         self._http_server: asyncio.AbstractServer | None = None
+        self._inbound: set[asyncio.StreamWriter] = set()  # live peer conns
 
     # ------------------------------------------------------------------ #
     # lifecycle
@@ -107,6 +108,13 @@ class StorageNodeServer:
 
     async def stop(self) -> None:
         self.health.stop()
+        self.client.close()   # drop pooled peer connections
+        # Peers keep POOLED connections into this node open indefinitely;
+        # Server.wait_closed() (3.12+) waits for every live handler, so
+        # idle inbound connections must be torn down explicitly or stop()
+        # deadlocks on a peer that simply hasn't spoken lately.
+        for w in list(self._inbound):
+            w.close()
         for srv in (self._internal_server, self._http_server):
             if srv is not None:
                 srv.close()
@@ -118,6 +126,7 @@ class StorageNodeServer:
 
     async def _handle_internal(self, reader: asyncio.StreamReader,
                                writer: asyncio.StreamWriter) -> None:
+        self._inbound.add(writer)
         try:
             while True:
                 try:
@@ -132,6 +141,7 @@ class StorageNodeServer:
         except (ConnectionError, OSError):
             pass
         finally:
+            self._inbound.discard(writer)
             writer.close()
             try:
                 await writer.wait_closed()
@@ -167,10 +177,13 @@ class StorageNodeServer:
                 self.counters.inc("announce_rejected_tombstoned")
             return {"ok": True}, b""
         if op == "tombstones":
+            # ts=None means the .tomb vanished between the glob and the
+            # read — a concurrent fresh re-upload cleared it. Advertising
+            # it would invite peers to re-delete the acknowledged upload.
             ms = self.store.manifests
-            return {"ok": True,
-                    "tombs": [{"id": fid, "ts": ms.tombstone_ts(fid)}
-                              for fid in ms.tombstones()]}, b""
+            tombs = [{"id": fid, "ts": ts} for fid in ms.tombstones()
+                     if (ts := ms.tombstone_ts(fid)) is not None]
+            return {"ok": True, "tombs": tombs}, b""
         if op == "list_manifests":
             return {"ok": True, "ids": self.store.manifests.ids()}, b""
         if op == "get_chunk":
@@ -638,8 +651,11 @@ class StorageNodeServer:
                     for (d, b), h in zip(got, hexes):
                         # verify against the requested digest before
                         # trusting a peer (per-chunk integrity, stronger
-                        # than the reference's whole-file-only check)
-                        if d in need and h == d and len(b) == need[d]:
+                        # than the reference's whole-file-only check);
+                        # `d not in out` keeps a racing batch from
+                        # double-counting a chunk another peer delivered
+                        if (d in need and d not in out and h == d
+                                and len(b) == need[d]):
                             out[d] = b
                             self.counters.inc("chunks_fetched_remote")
                 batch, size = [], 0
@@ -663,9 +679,34 @@ class StorageNodeServer:
                                    for nid, ds in groups.items()))
             tried.update(groups)
 
-        # stragglers (all batched candidates exhausted / corrupt): the
-        # per-chunk path walks every replica candidate one last time
+        # straggler mop-up stays BATCHED: up to rf more rounds, each
+        # assigning every missing digest to exactly ONE replica candidate
+        # (round r -> r-th candidate) so no chunk's bytes cross the wire
+        # from two peers at once. The rounds above only ever ask a
+        # digest's first-choice holder (and exclude a peer cluster-wide
+        # once tried), so a peer that answered a batch but lacked a few
+        # chunks leaves those here — previously a serial
+        # one-RPC-per-chunk walk.
+        for r in range(rf):
+            missing = [d for d in need if d not in out]
+            if not missing:
+                break
+            by_peer: dict[int, list[str]] = {}
+            for d in missing:
+                cands = [t for t in replica_set(d, ids, rf)
+                         if t != self.cfg.node_id]
+                if cands:
+                    by_peer.setdefault(cands[min(r, len(cands) - 1)],
+                                       []).append(d)
+            if not by_peer:
+                break
+            await asyncio.gather(*(fetch_batches(nid, ds)
+                                   for nid, ds in by_peer.items()))
         missing = [d for d in need if d not in out]
+
+        # terminal per-chunk path: only chunks NO replica produced valid
+        # bytes for reach here — walks candidates once more, then raises
+        # (strict) or skips (repair's best-effort)
         if missing:
             sem = asyncio.Semaphore(8)
 
@@ -837,15 +878,21 @@ class StorageNodeServer:
                 # cycle and silently stop the cluster converging
                 if fid in known or not is_hex_digest(fid):
                     continue
+                if ts is None:
+                    # tombstone no longer exists on the peer (cleared by a
+                    # concurrent fresh re-upload). Applying it with ts=None
+                    # would re-stamp a FRESH local timestamp that postdates
+                    # the re-uploaded manifest and propagate the deletion
+                    # of an acknowledged upload cluster-wide. Skip it.
+                    continue
                 try:
-                    ts = None if ts is None else float(ts)
-                    if ts is not None and not math.isfinite(ts):
+                    ts = float(ts)
+                    if not math.isfinite(ts):
                         continue   # NaN defeats every LWW comparison
                 except (TypeError, ValueError):
                     continue
                 local_mtime = self.store.manifests.mtime(fid)
-                if (local_mtime is not None and ts is not None
-                        and local_mtime > ts):
+                if local_mtime is not None and local_mtime > ts:
                     # our manifest postdates the delete: the tombstone is
                     # stale — resurrect the file on the lagging peer
                     m = self.store.manifests.load(fid)
@@ -997,19 +1044,29 @@ class StorageNodeServer:
         runs at read time on the whole file (StorageNode.java:453-458);
         scrubbing finds rot before a read does."""
         scanned = corrupt = 0
-        for d in self.store.chunks.digests():
-            b = self.store.chunks.get(d)
-            if b is None:
-                continue
-            scanned += 1
-            if sha256_hex(b) != d:
-                corrupt += 1
-                self.store.chunks.delete(d)
-                self.under_replicated.add(d)
-                self.log.warning("scrub: corrupt chunk %s deleted", d[:12])
-            # yield the event loop between chunks: scrubbing is a
-            # background activity, not a latency spike for live requests
-            await asyncio.sleep(0)
+        digests = self.store.chunks.digests()
+        # read+hash happen OFF the event loop in worker-thread batches
+        # (chunks are up to max_chunk bytes; hashing one inline would
+        # stall live requests — upload/download already to_thread theirs),
+        # batched through sha256_many_hex like range reads are
+        batch_n = 64
+        for i in range(0, len(digests), batch_n):
+            batch = digests[i:i + batch_n]
+
+            def read_and_hash(ds=batch) -> list[tuple[str, bool]]:
+                present = [(d, b) for d in ds
+                           if (b := self.store.chunks.get(d)) is not None]
+                hexes = sha256_many_hex([b for _, b in present])
+                return [(d, h == d) for (d, _), h in zip(present, hexes)]
+
+            for d, ok in await asyncio.to_thread(read_and_hash):
+                scanned += 1
+                if not ok:
+                    corrupt += 1
+                    self.store.chunks.delete(d)
+                    self.under_replicated.add(d)
+                    self.log.warning("scrub: corrupt chunk %s deleted",
+                                     d[:12])
         self.counters.inc("scrubs")
         if corrupt:
             self.counters.inc("scrub_corrupt", corrupt)

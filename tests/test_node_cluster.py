@@ -242,6 +242,45 @@ def test_dedup_skips_transfer(tmp_path, rng):
     asyncio.run(run())
 
 
+def test_rpc_connection_reuse(tmp_path, rng):
+    """The storage plane must NOT reconnect per RPC: across an upload +
+    cross-node download, each node dials each peer a bounded number of
+    times (pool warm-up + concurrency), far fewer than the RPC count."""
+    import dfs_tpu.comm.rpc as rpc_mod
+
+    data = rng.integers(0, 256, size=120_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path)
+        dials = 0
+        real_open = asyncio.open_connection
+
+        async def counting_open(*a, **kw):
+            nonlocal dials
+            dials += 1
+            return await real_open(*a, **kw)
+
+        rpc_mod.asyncio.open_connection = counting_open
+        try:
+            m, _ = await nodes[1].upload(data, "pooled.bin")
+            for _ in range(5):
+                _, got = await nodes[2].download(m.file_id)
+                assert got == data
+            calls = sum(n.counters.snapshot().get("chunks_fetched_remote", 0)
+                        for n in nodes.values())
+            # 2 peers × ≤ pool size dials per node would be the cap if
+            # everything were perfectly reused; allow slack for handshake
+            # concurrency but reconnect-per-RPC (≥ 1 dial per call) fails
+            assert dials <= 3 * rpc_mod.InternalClient._MAX_IDLE_PER_PEER * 2, \
+                f"{dials} dials for {calls}+ RPCs — pool not reusing"
+        finally:
+            rpc_mod.asyncio.open_connection = real_open
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
 def test_http_api_roundtrip(tmp_path, rng):
     """Full external-surface parity pass over real HTTP: /status /files
     /upload /download /metrics /manifest + DELETE (reference routes
@@ -487,6 +526,12 @@ def test_range_download(tmp_path, rng):
                 raise AssertionError("expected 416")
             except RuntimeError as e:
                 assert "416" in str(e)
+            # first > last is syntactically INVALID per RFC 9110 §14.1.1:
+            # the header must be ignored (full 200 body), not answered 416
+            got = await asyncio.to_thread(
+                c1._request, "GET", f"/download?fileId={fid}", None,
+                {"Range": "bytes=5-2"})
+            assert got == data
         finally:
             await stop_nodes(nodes)
 
@@ -619,6 +664,64 @@ def test_stale_tombstone_does_not_destroy_reupload(tmp_path, rng):
             assert not nodes[3].store.manifests.is_tombstoned(fid)
             _, got = await nodes[2].download(fid)
             assert got == data
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_tombstone_ts_none_skipped_by_antientropy(tmp_path, rng):
+    """A tombs entry arriving with ts=None (the peer's .tomb vanished
+    between its glob and ts read — the concurrent fresh-re-upload race)
+    must be SKIPPED. Applying it would stamp a fresh local timestamp that
+    postdates the re-uploaded manifest and propagate deletion of an
+    acknowledged upload cluster-wide."""
+    data = rng.integers(0, 256, size=30_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(2)
+        nodes = await start_nodes(cluster, tmp_path,
+                                  retries=1, connect_timeout_s=0.3)
+        try:
+            m, _ = await nodes[1].upload(data, "race.bin")
+            fid = m.file_id
+
+            real_call = nodes[1].client.call
+
+            async def evil_call(peer, header, body=b"", retries=None):
+                if header.get("op") == "tombstones":
+                    return {"ok": True,
+                            "tombs": [{"id": fid, "ts": None}]}, b""
+                return await real_call(peer, header, body, retries)
+
+            nodes[1].client.call = evil_call
+            await nodes[1]._tombstone_antientropy()
+            assert nodes[1].store.manifests.load(fid) is not None
+            assert not nodes[1].store.manifests.is_tombstoned(fid)
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_tombstones_rpc_drops_vanished_entries(tmp_path, rng):
+    """Server side of the same race: the tombstones op must not advertise
+    an id whose tombstone_ts reads back None."""
+
+    async def run():
+        cluster = make_cluster_cfg(1, rf=1)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            ms = nodes[1].store.manifests
+            ms.delete("a" * 64)              # real tombstone
+            assert ("a" * 64) in ms.tombstones()
+            real_ts = ms.tombstone_ts
+            ms.tombstone_ts = lambda fid: None   # simulate vanished .tomb
+            try:
+                resp, _ = await nodes[1]._dispatch({"op": "tombstones"}, b"")
+            finally:
+                ms.tombstone_ts = real_ts
+            assert resp["tombs"] == []
         finally:
             await stop_nodes(nodes)
 

@@ -4,8 +4,8 @@ Fulfils the roles of the reference's outbound peer calls
 (HttpURLConnection at StorageNode.java:226-259, 313-350, 471-483) with the
 same reliability envelope — per-attempt connect timeouts and bounded retries
 (reference: 2 s / 3 attempts, StorageNode.java:208,229-230) — but over the
-binary wire format and with connection reuse per request (the reference opens
-a fresh connection per call and pays Base64 inflation).
+binary wire format and with a persistent per-peer connection pool (the
+reference opens a fresh connection per call and pays Base64 inflation).
 
 Ops mirror the reference's internal API one-to-one:
 - store_chunks   ⇔ POST /internal/storeFragments (StorageNode.java:265-293),
@@ -23,7 +23,8 @@ from __future__ import annotations
 import asyncio
 from typing import Any
 
-from dfs_tpu.comm.wire import pack_chunks, read_msg, send_msg, unpack_chunks
+from dfs_tpu.comm.wire import (WireError, pack_chunks, read_msg, send_msg,
+                               unpack_chunks)
 from dfs_tpu.config import PeerAddr
 
 
@@ -42,28 +43,91 @@ class RpcRemoteError(RpcError):
 
 
 class InternalClient:
+    """Storage-plane RPC client with a per-peer persistent-connection
+    pool. The server side keeps framed connections open across requests
+    (StorageNodeServer._handle_internal loops until EOF), so reconnecting
+    per call — the reference's behavior, and this client's until round 3
+    — paid a connect round-trip on every has_chunks/store/fetch."""
+
+    _MAX_IDLE_PER_PEER = 4
+
     def __init__(self, connect_timeout_s: float = 2.0,
                  request_timeout_s: float = 10.0, retries: int = 3) -> None:
         self.connect_timeout_s = connect_timeout_s
         self.request_timeout_s = request_timeout_s
         self.retries = retries
+        self._pool: dict[tuple[str, int],
+                         list[tuple[asyncio.StreamReader,
+                                    asyncio.StreamWriter]]] = {}
+
+    def _checkout(self, peer: PeerAddr):
+        """Pop a live pooled connection, or None to signal a fresh dial."""
+        pool = self._pool.get((peer.host, peer.internal_port))
+        while pool:
+            reader, writer = pool.pop()
+            if writer.is_closing() or reader.at_eof():
+                writer.close()
+                continue
+            return reader, writer
+        return None
+
+    def _checkin(self, peer: PeerAddr, conn) -> None:
+        reader, writer = conn
+        pool = self._pool.setdefault((peer.host, peer.internal_port), [])
+        if len(pool) < self._MAX_IDLE_PER_PEER and not writer.is_closing():
+            pool.append(conn)
+        else:
+            writer.close()
+
+    def close(self) -> None:
+        """Drop every pooled connection (node shutdown)."""
+        for pool in self._pool.values():
+            for _, writer in pool:
+                writer.close()
+        self._pool.clear()
+
+    async def _request(self, conn, header: dict,
+                       body: bytes) -> tuple[dict, bytes]:
+        _, writer = conn
+        await asyncio.wait_for(send_msg(writer, header, body),
+                               timeout=self.request_timeout_s)
+        return await asyncio.wait_for(
+            read_msg(conn[0]), timeout=self.request_timeout_s)
 
     async def _call_once(self, peer: PeerAddr, header: dict,
                          body: bytes) -> tuple[dict, bytes]:
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(peer.host, peer.internal_port),
-            timeout=self.connect_timeout_s)
+        conn = self._checkout(peer)
+        reused = conn is not None
+        if conn is None:
+            conn = await asyncio.wait_for(
+                asyncio.open_connection(peer.host, peer.internal_port),
+                timeout=self.connect_timeout_s)
         try:
-            await asyncio.wait_for(send_msg(writer, header, body),
-                                   timeout=self.request_timeout_s)
-            resp, rbody = await asyncio.wait_for(
-                read_msg(reader), timeout=self.request_timeout_s)
-        finally:
-            writer.close()
+            resp, rbody = await self._request(conn, header, body)
+        except (ConnectionError, asyncio.IncompleteReadError, WireError):
+            # disconnect-class only: a pooled connection the server closed
+            # while idle surfaces as reset/EOF on the first frame, and is
+            # not evidence the peer is down — retry ONCE on a fresh dial.
+            # A request TIMEOUT must NOT take this path: the peer may
+            # still be processing, and a silent resend would duplicate
+            # work and double the health monitor's fast-fail budget.
+            conn[1].close()
+            if not reused:
+                raise
+            conn = await asyncio.wait_for(
+                asyncio.open_connection(peer.host, peer.internal_port),
+                timeout=self.connect_timeout_s)
             try:
-                await writer.wait_closed()
-            except (ConnectionError, OSError):
-                pass
+                resp, rbody = await self._request(conn, header, body)
+            except BaseException:
+                conn[1].close()
+                raise
+        except BaseException:
+            conn[1].close()
+            raise
+        # request/response completed: the connection is still in frame
+        # sync even for an application-level error — pool it either way
+        self._checkin(peer, conn)
         if not resp.get("ok", False):
             raise RpcRemoteError(
                 f"peer {peer.node_id} error: {resp.get('error')}")

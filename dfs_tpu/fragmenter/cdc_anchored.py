@@ -30,8 +30,10 @@ import numpy as np
 from dfs_tpu.fragmenter.base import Fragmenter
 from dfs_tpu.meta.manifest import ChunkRef, Manifest
 from dfs_tpu.ops.cdc_anchored import (TILE_BYTES, AnchoredCdcParams,
+                                      CutCapacityOverflow,
                                       chunk_file_anchored_np, region_buffer,
-                                      region_collect, region_dispatch)
+                                      region_chunks, region_collect,
+                                      region_dispatch)
 from dfs_tpu.ops.cdc_v2 import file_id_from_digests
 
 _REGION_BYTES = 64 * 1024 * 1024
@@ -112,14 +114,14 @@ class AnchoredTpuFragmenter(_AnchoredBase):
     def _dispatch_window(self, fetch, base: int, n: int, start0,
                          final: bool) -> tuple:
         """device_put window [base, min(n, base+region_bytes)) and dispatch
-        the fused chain; returns (base, out) with out all device arrays.
-        ``fetch(off, ln)`` must return stream bytes as a u8 array for any
-        span inside [base-8, end). ``final`` must be passed explicitly —
-        inferring it from end == n would misfire mid-stream when the bytes
-        received so far happen to land exactly on a window end. Buffer
-        shapes bucket to the next power of two (region_buffer), so a
-        multi-window walk compiles once for the full windows plus at most
-        once for the shorter tail window."""
+        the fused chain; returns (base, end, final, out) with out all
+        device arrays. ``fetch(off, ln)`` must return stream bytes as a u8
+        array for any span inside [base-8, end). ``final`` must be passed
+        explicitly — inferring it from end == n would misfire mid-stream
+        when the bytes received so far happen to land exactly on a window
+        end. Buffer shapes bucket to the next power of two (region_buffer),
+        so a multi-window walk compiles once for the full windows plus at
+        most once for the shorter tail window."""
         import jax
 
         end = min(n, base + self.region_bytes)
@@ -131,15 +133,29 @@ class AnchoredTpuFragmenter(_AnchoredBase):
             fetch(base, end - base), lookback, self.params))
         out = region_dispatch(words, end - base, start0, final,
                               self.params, lane_multiple=self.lane_multiple)
-        return base, out
+        return base, end, final, out
 
-    def _collect_window(self, base: int, out, fetch,
+    def _collect_window(self, base: int, end: int, final: bool, out, fetch,
                         chunks: list[ChunkRef], store) -> int:
         """Pull one window's results, append absolute-offset ChunkRefs;
         returns the absolute consumed bound. Verifies span contiguity (the
         device-chained carry has no per-region host check)."""
-        spans, consumed = region_collect(out)
         expect = chunks[-1].offset + chunks[-1].length if chunks else 0
+        try:
+            spans, consumed = region_collect(out)
+        except CutCapacityOverflow:
+            # this window's content out-chunked the tight cut capacity —
+            # redo it alone at the worst-case bound. The device carry
+            # (consumed) that later windows chained on is capacity-
+            # independent, so the rest of the pipeline stays valid.
+            lookback = np.zeros((8,), np.uint8)
+            take = min(8, base)
+            if take:
+                lookback[8 - take:] = fetch(base - take, take)
+            spans, consumed = region_chunks(
+                fetch(base, end - base), lookback, expect - base, final,
+                self.params, lane_multiple=self.lane_multiple,
+                cap_mode="full")
         for o, ln, dg in spans:
             off = base + o
             if off != expect:
@@ -175,15 +191,15 @@ class AnchoredTpuFragmenter(_AnchoredBase):
             if len(pending) >= self.max_inflight:   # cap live windows
                 self._collect_window(*pending.pop(0), fetch, chunks, store)
             final = base + self.region_bytes >= n
-            b, out = self._dispatch_window(fetch, base, n, start0, final)
-            pending.append((b, out))
+            win = self._dispatch_window(fetch, base, n, start0, final)
+            pending.append(win)
             if final:
                 break
-            start0 = out[0] - self.stride   # device-resident carry
+            start0 = win[3][0] - self.stride   # device-resident carry
             base += self.stride
         bound = 0
-        for b, out in pending:
-            bound = self._collect_window(b, out, fetch, chunks, store)
+        for win in pending:
+            bound = self._collect_window(*win, fetch, chunks, store)
         if bound != n:
             raise AssertionError(f"anchored walk ended at {bound} != {n}")
         return chunks
@@ -234,14 +250,14 @@ class AnchoredTpuFragmenter(_AnchoredBase):
                 if len(pending) >= self.max_inflight:
                     self._collect_window(*pending.pop(0), fetch, chunks,
                                          store)
-                b, out = self._dispatch_window(fetch, base, n_known, start0,
-                                               final)
-                pending.append((b, out))
+                win = self._dispatch_window(fetch, base, n_known, start0,
+                                            final)
+                pending.append(win)
                 trim()
                 if final:
                     done = True
                     return
-                start0 = out[0] - self.stride
+                start0 = win[3][0] - self.stride
                 base += self.stride
 
         for blk in blocks:

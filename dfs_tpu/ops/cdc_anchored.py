@@ -147,13 +147,17 @@ def kept_anchors_np(data: np.ndarray,
 # ---------------------------------------------------------------------------
 
 def select_segments(anchors: np.ndarray, n: int,
-                    params: AnchoredCdcParams) -> np.ndarray:
-    """Exclusive segment boundaries over a stream of ``n`` bytes; last
-    element == n. Boundary after byte p means segment ends at p (boundary
-    value p+1). Rule: LAST kept anchor with start+seg_min <= p+1 <=
-    start+seg_max; none -> forced at start+seg_max."""
+                    params: AnchoredCdcParams, start0: int = 0,
+                    final: bool = True) -> np.ndarray:
+    """Exclusive segment boundaries over a stream of ``n`` bytes; when
+    ``final``, the last element == n. Boundary after byte p means segment
+    ends at p (boundary value p+1). Rule: LAST kept anchor with
+    start+seg_min <= p+1 <= start+seg_max; none -> forced at
+    start+seg_max. ``start0``/``final=False`` give the region-walk
+    semantics (start at a carry position; withhold the unfinished tail
+    segment so it carries into the next region)."""
     bounds: list[int] = []
-    start = 0
+    start = int(start0)
     ap = np.asarray(anchors, dtype=np.int64)
     while n - start > params.seg_max:
         lo = start + params.seg_min            # min admissible boundary
@@ -166,13 +170,31 @@ def select_segments(anchors: np.ndarray, n: int,
             b = hi
         bounds.append(b)
         start = b
-    bounds.append(n)
+    if final:
+        bounds.append(n)
     return np.asarray(bounds, dtype=np.int64)
 
 
 # ---------------------------------------------------------------------------
 # full oracle: anchors -> segments -> aligned chunking per segment
 # ---------------------------------------------------------------------------
+
+def _segment_spans_np(data: np.ndarray, start: int, b: int,
+                      cp: AlignedCdcParams) -> list[tuple[int, int]]:
+    """Aligned chunking of segment [start, b), grid re-anchored at start."""
+    seg = data[start:b]
+    ln = seg.shape[0]
+    nb = -(-ln // BLOCK)
+    pos = np.flatnonzero(candidates_np(seg, cp))
+    cuts = select_cuts_blocks(pos, nb, cp)
+    spans: list[tuple[int, int]] = []
+    prev = 0
+    for c in cuts.tolist():
+        end = min(c * BLOCK, ln)
+        spans.append((start + prev * BLOCK, end - prev * BLOCK))
+        prev = c
+    return spans
+
 
 def chunk_spans_anchored_np(data: np.ndarray, params: AnchoredCdcParams
                             ) -> list[tuple[int, int]]:
@@ -181,22 +203,47 @@ def chunk_spans_anchored_np(data: np.ndarray, params: AnchoredCdcParams
     if n == 0:
         return []
     bounds = select_segments(kept_anchors_np(data, params), n, params)
-    cp = params.chunk
     spans: list[tuple[int, int]] = []
     start = 0
     for b in bounds.tolist():
-        seg = data[start:b]
-        ln = seg.shape[0]
-        nb = -(-ln // BLOCK)
-        pos = np.flatnonzero(candidates_np(seg, cp))
-        cuts = select_cuts_blocks(pos, nb, cp)
-        prev = 0
-        for c in cuts.tolist():
-            end = min(c * BLOCK, ln)
-            spans.append((start + prev * BLOCK, end - prev * BLOCK))
-            prev = c
+        spans.extend(_segment_spans_np(data, start, b, params.chunk))
         start = b
     return spans
+
+
+def region_spans_np(data: np.ndarray, lookback: np.ndarray, start0: int,
+                    final: bool, params: AnchoredCdcParams
+                    ) -> tuple[list[tuple[int, int]], int]:
+    """Host oracle of :func:`region_chunks`'s span semantics (no digests):
+    region-local (offset, length) spans + consumed bound. Same contract:
+    ``lookback`` = 8 stream bytes before the region (zeros at stream
+    start), the region base must be TILE_BYTES-aligned in the stream,
+    and when ``final`` is False the unfinished tail segment is withheld.
+    Used as the streaming-walk fallback when the native library is
+    unavailable (dfs_tpu/native/cdc_core.cpp:dfs_anchored_spans_region is
+    the fast path)."""
+    n = int(data.shape[0])
+    if n == 0:
+        return [], int(start0)
+    ext = np.concatenate([np.asarray(lookback, np.uint8).reshape(8),
+                          np.asarray(data)])
+    hit = (anchor_hash_np(ext, params) & np.uint32(params.seg_mask)) == 0
+    pos = np.flatnonzero(hit[8:])          # region-local positions
+    if pos.size:
+        tile = pos // TILE_BYTES
+        first = np.ones_like(pos, dtype=bool)
+        first[1:] = tile[1:] != tile[:-1]
+        anchors = pos[first].astype(np.int64)
+    else:
+        anchors = pos.astype(np.int64)
+    bounds = select_segments(anchors, n, params, start0=int(start0),
+                             final=bool(final))
+    spans: list[tuple[int, int]] = []
+    start = int(start0)
+    for b in bounds.tolist():
+        spans.extend(_segment_spans_np(data, start, b, params.chunk))
+        start = b
+    return spans, start
 
 
 def chunk_file_anchored_np(data: np.ndarray, params: AnchoredCdcParams
@@ -354,9 +401,13 @@ def make_descriptor_fn(params: AnchoredCdcParams, cap: int, s_pad: int):
 # device pass B: repack segments into lanes + aligned chunk/hash
 # ---------------------------------------------------------------------------
 
+class CutCapacityOverflow(RuntimeError):
+    """More cuts than the tight capacity — caller retries at full bound."""
+
+
 @functools.cache
 def make_anchored_segment_fn(params: AnchoredCdcParams, m_words: int,
-                             s_pad: int):
+                             s_pad: int, cap_mode: str = "tight"):
     """Compiled: (words_le [m_words] u32 — the resident batch,
     w_off [s_pad] i32 (word floor of each segment start),
     sh8 [s_pad] u32 (8 * (start % 4)),
@@ -387,8 +438,19 @@ def make_anchored_segment_fn(params: AnchoredCdcParams, m_words: int,
     # rounded-up tail per lane, and cuts <= blocks/min + one forced
     # lane-final cut per lane (1.5x tighter than the per-lane bound alone
     # at default params; the finalize + gathers scale with c_max)
-    c_max = min(cut_capacity(s_pad, cp),
-                (m_words // 16 + s_pad) // cp.min_blocks + s_pad)
+    c_full = min(cut_capacity(s_pad, cp),
+                 (m_words // 16 + s_pad) // cp.min_blocks + s_pad)
+    if cap_mode == "tight":
+        # provision for 1.25x the EXPECTED cut count (blocks/avg + one
+        # forced cut per lane), not the worst case: capacity-scaled work
+        # (scatter, state/len gathers, finalize) measured 3.1 ms of a
+        # 13.4 ms region at the full bound. Content dense enough to
+        # overflow raises CutCapacityOverflow at collect (the count is
+        # exact) and the caller redispatches this window at "full".
+        c_max = min(c_full,
+                    (m_words // 16 // cp.avg_blocks + s_pad) * 5 // 4)
+    else:
+        c_max = c_full
     use_pallas = s_pad % 128 == 0 and any(
         d.platform == "tpu" for d in jax.devices())
     t_tile = 128 if bps % 128 == 0 else bps
@@ -477,10 +539,11 @@ def make_anchored_segment_fn(params: AnchoredCdcParams, m_words: int,
         # tail block content (LE) regathered from the region buffer (the
         # repacked lanes are not kept — dropping the 96 MiB intermediate
         # output pays for this 17-word-per-lane gather many times over),
-        # masked beyond tail_len, 0x80 appended
-        widx = w_off[:, None] + (last_t * 16)[:, None] \
-            + jnp.arange(17, dtype=jnp.int32)[None, :]
-        x = jnp.take(words, widx)                       # [s_pad, 17]
+        # masked beyond tail_len, 0x80 appended. Row-contiguous
+        # vmap(dynamic_slice), NOT an element-index jnp.take: the [s, 17]
+        # index-matrix gather measured ~0.6 ms slower per region on v5e.
+        x = jax.vmap(lambda o: jax.lax.dynamic_slice(
+            words, (o,), (17,)))(w_off + last_t * 16)   # [s_pad, 17]
         sh = sh8[:, None]
         tw = jnp.where(sh == 0, x[:, :-1],
                        (x[:, :-1] >> sh)
@@ -538,6 +601,7 @@ def make_anchored_segment_fn(params: AnchoredCdcParams, m_words: int,
         return compact_half(cf32, since, state_rows, words, w_off, sh8,
                             real_blocks, tail_len, starts, seg_lens)
 
+    run.halves = (scan_half, compact_half)   # stage profiling hook
     return run
 
 
@@ -578,7 +642,8 @@ def _dev_bool(v: bool):
 
 
 def region_dispatch(words, n: int, start0, final: bool,
-                    params: AnchoredCdcParams, lane_multiple: int = 128):
+                    params: AnchoredCdcParams, lane_multiple: int = 128,
+                    cap_mode: str = "tight"):
     """Dispatch the fused anchor->select->descriptor->chunk/hash chain on a
     device-resident region buffer (``words`` from :func:`region_buffer`,
     already device_put). ``start0`` may be a host int or a device scalar —
@@ -604,7 +669,7 @@ def region_dispatch(words, n: int, start0, final: bool,
     (starts, seg_lens, w_off, sh8, real_blocks, tail_len,
      consumed) = make_descriptor_fn(params, cap, s_pad)(bounds, start0)
     count, q, offs, lens, dig = make_anchored_segment_fn(
-        params, int(words.shape[0]), s_pad)(
+        params, int(words.shape[0]), s_pad, cap_mode)(
         words, w_off, sh8, real_blocks, tail_len, starts, seg_lens)
     return consumed, count, q, offs, lens, dig
 
@@ -619,6 +684,12 @@ def region_collect(out) -> tuple[list[tuple[int, int, str]], int]:
 
     consumed, count, q, offs, lens, dig = jax.device_get(out)
     count = int(count)
+    if count > q.shape[0]:
+        # content denser than the tight provisioning (cap_mode="tight" in
+        # region_dispatch) — the first q.shape[0] cuts are valid but the
+        # rest were dropped; the caller must redispatch at "full"
+        raise CutCapacityOverflow(
+            f"{count} cuts > capacity {q.shape[0]}")
     if count and (q[:count] < 0).any():
         raise AssertionError("anchored cut compaction overflowed a tile")
     hexes = digests_to_hex(dig[:count])
@@ -629,7 +700,7 @@ def region_collect(out) -> tuple[list[tuple[int, int, str]], int]:
 
 def region_chunks(data: np.ndarray, lookback: np.ndarray, start0: int,
                   final: bool, params: AnchoredCdcParams,
-                  lane_multiple: int = 128
+                  lane_multiple: int = 128, cap_mode: str = "tight"
                   ) -> tuple[list[tuple[int, int, str]], int]:
     """Chunk one stream region on device.
 
@@ -653,8 +724,15 @@ def region_chunks(data: np.ndarray, lookback: np.ndarray, start0: int,
         return [], 0
     words = jax.device_put(region_buffer(data, lookback, params))
     out = region_dispatch(words, n, start0, final, params,
-                          lane_multiple=lane_multiple)
-    return region_collect(out)
+                          lane_multiple=lane_multiple, cap_mode=cap_mode)
+    try:
+        return region_collect(out)
+    except CutCapacityOverflow:
+        # denser than the tight provisioning: one synchronous redo at the
+        # worst-case bound (rare by construction; see cap_mode)
+        out = region_dispatch(words, n, start0, final, params,
+                              lane_multiple=lane_multiple, cap_mode="full")
+        return region_collect(out)
 
 
 def batch_chunks_anchored(data: np.ndarray, params: AnchoredCdcParams,

@@ -119,6 +119,62 @@ def test_device_tail_digests():
                 data[o:o + ln].tobytes()).hexdigest()
 
 
+def _dense_byte() -> int:
+    """A uniform byte value whose 64-byte block is a Gear candidate under
+    SMALL.chunk — filling a stream with it forces a cut every min_blocks,
+    ~avg/min times the provisioned expectation."""
+    from dfs_tpu.ops.cdc_v2 import candidates_np
+
+    return next(v for v in range(256)
+                if candidates_np(np.full(64, v, np.uint8),
+                                 SMALL.chunk).any())
+
+
+def test_tight_capacity_overflow_redispatches(monkeypatch):
+    """Cut capacity is provisioned for ~1.25x the EXPECTED count
+    (cap_mode='tight'); content cutting at min_blocks everywhere must be
+    detected (the device count is exact) and redone at the worst-case
+    bound — byte-identical to the oracle, never silently truncated."""
+    import dfs_tpu.ops.cdc_anchored as A
+
+    data = np.full(100000, _dense_byte(), dtype=np.uint8)
+    calls: list[str] = []
+    orig = A.region_dispatch
+
+    def spy(*a, **kw):
+        calls.append(kw.get("cap_mode", "tight"))
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(A, "region_dispatch", spy)
+    got = batch_chunks_anchored(data, SMALL, lane_multiple=8)
+    assert "full" in calls, "dense content never hit the retry path"
+    assert got == chunk_file_anchored_np(data, SMALL)
+
+
+def test_tight_capacity_overflow_in_region_walk(monkeypatch):
+    """Same retry through the pipelined multi-window walk (the fragmenter
+    collect path), where the device carry chained past the overflowing
+    window must stay valid."""
+    import dfs_tpu.fragmenter.cdc_anchored as F
+
+    data = np.full(200000, _dense_byte(), dtype=np.uint8).tobytes()
+    calls: list[str] = []
+    orig = F.region_chunks
+
+    def spy(*a, **kw):
+        calls.append(kw.get("cap_mode", "tight"))
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(F, "region_chunks", spy)
+    # 64 KiB windows: at SMALL's geometry the dense cut count per window
+    # (stride/min_bytes) clears the tight bound; 16 KiB windows would not
+    got = anchored_frag(region_bytes=65536).chunk(data)
+    assert "full" in calls, "walk never hit the collect-retry path"
+    arr = np.frombuffer(data, np.uint8)
+    assert [(c.offset, c.length, c.digest) for c in got] == \
+        chunk_file_anchored_np(arr, SMALL)
+
+
 # ----------------------------------------------------------- fragmenters --
 
 def anchored_frag(**kw):

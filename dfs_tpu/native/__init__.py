@@ -92,6 +92,14 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.c_uint64, ctypes.c_uint64, ctypes.c_void_p,
         ctypes.c_uint64]
     lib.dfs_anchored_spans.restype = ctypes.c_int64
+    lib.dfs_anchored_spans_region.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_void_p,
+        ctypes.c_uint64, ctypes.c_void_p]
+    lib.dfs_anchored_spans_region.restype = ctypes.c_int64
 
 
 def native_sha256_many(chunks: list[bytes]) -> list[str] | None:
@@ -144,6 +152,39 @@ def native_anchored_spans(data: bytes | np.ndarray,
     if wrote < 0:
         return None
     return spans[:wrote].astype(np.int64)
+
+
+def native_anchored_spans_region(
+        data: bytes | np.ndarray, lookback: np.ndarray, start0: int,
+        final: bool, params) -> tuple[np.ndarray, int] | None:
+    """Window edition of :func:`native_anchored_spans` (the C mirror of
+    ops.cdc_anchored.region_chunks semantics): returns ([n, 2] int64
+    region-local (offset, length), consumed) or None if the native lib is
+    unavailable. The stream offset of data[0] must be TILE_BYTES-aligned."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    arr = np.frombuffer(data, dtype=np.uint8) if isinstance(
+        data, (bytes, bytearray, memoryview)) else data
+    arr = np.ascontiguousarray(arr)
+    n = int(arr.shape[0])
+    if n == 0:
+        return np.zeros((0, 2), dtype=np.int64), start0
+    cp = params.chunk
+    cap = n // (cp.min_blocks * 64) + n // params.seg_min + 3
+    spans = np.empty((cap, 2), dtype=np.uint64)
+    lb = np.ascontiguousarray(lookback, dtype=np.uint8)
+    consumed = ctypes.c_uint64(0)
+    from dfs_tpu.ops.cdc_anchored import TILE_BYTES
+
+    wrote = lib.dfs_anchored_spans_region(
+        arr.ctypes.data, n, lb.ctypes.data, start0, int(final),
+        params.seed, params.seg_mask, params.seg_min, params.seg_max,
+        TILE_BYTES, cp.seed, cp.mask, cp.min_blocks, cp.max_blocks,
+        spans.ctypes.data, cap, ctypes.byref(consumed))
+    if wrote < 0:
+        return None
+    return spans[:wrote].astype(np.int64), int(consumed.value)
 
 
 def native_gear_cuts(data: bytes | np.ndarray, table: np.ndarray, mask: int,

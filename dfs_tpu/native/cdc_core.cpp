@@ -137,21 +137,29 @@ int64_t dfs_gear_cuts(const uint8_t* data, uint64_t len,
   return int64_t(n_cuts);
 }
 
-// Anchored two-level CDC spans — bit-identical to the NumPy oracle
-// (dfs_tpu/ops/cdc_anchored.chunk_spans_anchored_np): byte-granular
-// anchors (8-byte windowed hash, first-per-tile quantization) choose
-// segment boundaries; within each segment the 64-byte-aligned windowed
-// Gear grid re-anchors at the segment start. This is the fast host
-// engine for accelerator-less nodes running the flagship strategy.
-// Writes (offset, length) u64 pairs into `spans` (capacity span_cap
-// pairs); returns the pair count, or -1 on overflow/alloc failure.
-int64_t dfs_anchored_spans(const uint8_t* data, uint64_t len,
-                           uint32_t anchor_seed, uint32_t seg_mask,
-                           uint64_t seg_min, uint64_t seg_max,
-                           uint64_t tile_bytes, uint32_t chunk_seed,
-                           uint32_t avg_mask, uint64_t min_blocks,
-                           uint64_t max_blocks, uint64_t* spans,
-                           uint64_t span_cap) {
+// Anchored two-level CDC spans for ONE WINDOW of a longer stream —
+// region edition of dfs_anchored_spans, mirroring the device walk's
+// contract (dfs_tpu/ops/cdc_anchored.region_chunks): `lookback` holds
+// the 8 stream bytes before data[0] (zeros at true stream start; the
+// window base must be tile-aligned in the stream so first-per-tile
+// anchor quantization matches the whole-stream result); `start0` is the
+// carry position inside the window (bytes before it belong to segments
+// a previous window already emitted); `final` != 0 iff the stream ends
+// at data[len-1] — otherwise the unfinished tail segment is withheld so
+// its bytes carry into the next window. Writes region-local (offset,
+// length) pairs; sets *consumed to the bound segments were emitted up
+// to (== len when final). Returns the pair count, or -1 on
+// overflow/alloc failure.
+int64_t dfs_anchored_spans_region(const uint8_t* data, uint64_t len,
+                                  const uint8_t* lookback, uint64_t start0,
+                                  int final_region, uint32_t anchor_seed,
+                                  uint32_t seg_mask, uint64_t seg_min,
+                                  uint64_t seg_max, uint64_t tile_bytes,
+                                  uint32_t chunk_seed, uint32_t avg_mask,
+                                  uint64_t min_blocks, uint64_t max_blocks,
+                                  uint64_t* spans, uint64_t span_cap,
+                                  uint64_t* consumed) {
+  *consumed = start0;
   if (len == 0) return 0;
 
   // ---- pass A: first qualifying anchor per tile (-1 = none) ----
@@ -160,6 +168,8 @@ int64_t dfs_anchored_spans(const uint8_t* data, uint64_t len,
   if (!tile_anchor) return -1;
   for (uint64_t t = 0; t < n_tiles; ++t) tile_anchor[t] = -1;
   uint64_t reg = 0;  // bytes[p-7..p], data[p] in the top byte (LE window)
+  for (int i = 0; i < 8; ++i)
+    reg = (reg >> 8) | (uint64_t(lookback[i]) << 56);
   for (uint64_t p = 0; p < len; ++p) {
     reg = (reg >> 8) | (uint64_t(data[p]) << 56);
     uint32_t b = uint32_t(reg >> 32);
@@ -177,12 +187,13 @@ int64_t dfs_anchored_spans(const uint8_t* data, uint64_t len,
     G[v] = fmix32(chunk_seed ^ (v * 0x9E3779B1u));
 
   // ---- segment walk + per-segment aligned chunking ----
-  uint64_t n_spans = 0, start = 0;
+  uint64_t n_spans = 0, start = start0;
   bool ok = true;
   while (ok) {
     uint64_t bound;
     if (len - start <= seg_max) {
-      bound = len;  // final segment
+      if (!final_region) break;  // tail carries into the next window
+      bound = len;               // final segment
     } else {
       // last kept anchor a with start+seg_min <= a+1 <= start+seg_max
       uint64_t lo = start + seg_min - 1, hi = start + seg_max - 1;
@@ -220,11 +231,31 @@ int64_t dfs_anchored_spans(const uint8_t* data, uint64_t len,
         since = 0;
       }
     }
-    if (bound == len) break;
+    if (!ok) break;
     start = bound;
+    if (bound == len) break;
   }
   delete[] tile_anchor;
+  *consumed = start;
   return ok ? int64_t(n_spans) : -1;
+}
+
+// Whole-stream spans — bit-identical to the NumPy oracle
+// (dfs_tpu/ops/cdc_anchored.chunk_spans_anchored_np). One final region
+// starting from a zero lookback.
+int64_t dfs_anchored_spans(const uint8_t* data, uint64_t len,
+                           uint32_t anchor_seed, uint32_t seg_mask,
+                           uint64_t seg_min, uint64_t seg_max,
+                           uint64_t tile_bytes, uint32_t chunk_seed,
+                           uint32_t avg_mask, uint64_t min_blocks,
+                           uint64_t max_blocks, uint64_t* spans,
+                           uint64_t span_cap) {
+  uint8_t zeros[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+  uint64_t consumed = 0;
+  return dfs_anchored_spans_region(
+      data, len, zeros, 0, 1, anchor_seed, seg_mask, seg_min, seg_max,
+      tile_bytes, chunk_seed, avg_mask, min_blocks, max_blocks, spans,
+      span_cap, &consumed);
 }
 
 }  // extern "C"

@@ -54,6 +54,55 @@ class Fragmenter(abc.ABC):
                 store(c.digest, data[c.offset:c.offset + c.length])
         return m
 
+    def describe(self) -> dict:
+        """JSON-able description sufficient for ANOTHER process to
+        reproduce this fragmenter's chunk boundaries bit-exactly (the
+        resumable-upload protocol: the client chunks locally with the
+        node's advertised parameters, probes which digests the cluster
+        already holds, and transfers only the missing payloads).
+        Subclasses override; kinds map back via
+        :func:`fragmenter_from_description`."""
+        raise NotImplementedError(f"{self.name} is not resume-describable")
+
+    def _manifest_via_chunks_stream(self, blocks, name: str,
+                                    store) -> Manifest:
+        """Shared manifest assembly for backends whose streaming surface
+        is chunks_stream: drain it, size = last chunk end, file_id
+        derived from the digests (callers that need fileId=sha256(body)
+        — the node runtime — compute it themselves and override)."""
+        from dfs_tpu.ops.cdc_v2 import file_id_from_digests
+
+        chunks: list[ChunkRef] = []
+        for batch in self.chunks_stream(blocks, store=store):
+            chunks.extend(batch)
+        size = chunks[-1].offset + chunks[-1].length if chunks else 0
+        return Manifest(
+            file_id=file_id_from_digests([c.digest for c in chunks]),
+            name=name, size=size, fragmenter=self.name,
+            chunks=tuple(chunks))
+
+    def stream_span(self) -> int | None:
+        """Upper bound on how far chunks_stream's reporting can lag the
+        bytes it has consumed (the sidecar advertises this so a teeing
+        client can cap its buffer without risking deadlock). None =
+        unbounded (this base implementation materializes)."""
+        return None
+
+    def chunks_stream(self, blocks, store=None):
+        """Generator of ChunkRef batches in stream order, yielded AS the
+        stream is consumed — the incremental surface the sidecar's
+        stream-stream method serves from. Backends with a true streaming
+        walk (anchored CPU/TPU) override with bounded-memory
+        implementations; this fallback materializes for the same reason
+        manifest_stream's does."""
+        data = b"".join(blocks)
+        m = self.manifest(data, name="stream")
+        if store is not None:
+            for c in m.chunks:
+                store(c.digest, data[c.offset:c.offset + c.length])
+        if m.chunks:
+            yield list(m.chunks)
+
 
 def tpu_available(timeout_s: float = 15.0) -> bool:
     """True iff a TPU backend comes up within ``timeout_s``.
@@ -99,6 +148,43 @@ def _aligned_from_cdc(cdc_params):
         avg_blocks=max(1, cdc_params.avg_size // 64),
         max_blocks=max_blocks,
         strip_blocks=strip_blocks)
+
+
+def fragmenter_from_description(desc: dict) -> Fragmenter:
+    """Rebuild a chunk-compatible fragmenter from ``describe()`` output.
+    Always returns the CPU engine of the described strategy — chunk
+    boundaries and digests are bit-identical across CPU/TPU/sidecar by
+    construction (tests enforce it), which is exactly what resume
+    needs."""
+    from dfs_tpu.config import CDCParams
+
+    kind = desc.get("kind")
+    if kind == "fixed":
+        from dfs_tpu.fragmenter.fixed import FixedFragmenter
+
+        return FixedFragmenter(parts=int(desc["parts"]))
+    if kind == "cdc":
+        from dfs_tpu.fragmenter.cdc_cpu import CpuCdcFragmenter
+
+        return CpuCdcFragmenter(CDCParams(
+            min_size=int(desc["min_size"]), avg_size=int(desc["avg_size"]),
+            max_size=int(desc["max_size"]), seed=int(desc["seed"])))
+    if kind == "cdc-anchored":
+        from dfs_tpu.fragmenter.cdc_anchored import AnchoredCpuFragmenter
+        from dfs_tpu.ops.cdc_anchored import AnchoredCdcParams
+        from dfs_tpu.ops.cdc_v2 import AlignedCdcParams
+
+        c = desc["chunk"]
+        return AnchoredCpuFragmenter(AnchoredCdcParams(
+            chunk=AlignedCdcParams(
+                min_blocks=int(c["min_blocks"]),
+                avg_blocks=int(c["avg_blocks"]),
+                max_blocks=int(c["max_blocks"]),
+                strip_blocks=int(c["strip_blocks"]),
+                seed=int(c["seed"])),
+            seg_min=int(desc["seg_min"]), seg_max=int(desc["seg_max"]),
+            seg_mask=int(desc["seg_mask"]), seed=int(desc["seed"])))
+    raise ValueError(f"undescribable fragmenter kind {kind!r}")
 
 
 def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5) -> Fragmenter:

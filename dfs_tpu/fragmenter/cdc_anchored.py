@@ -33,7 +33,7 @@ from dfs_tpu.ops.cdc_anchored import (TILE_BYTES, AnchoredCdcParams,
                                       CutCapacityOverflow,
                                       chunk_file_anchored_np, region_buffer,
                                       region_chunks, region_collect,
-                                      region_dispatch)
+                                      region_dispatch, region_spans_np)
 from dfs_tpu.ops.cdc_v2 import file_id_from_digests
 
 _REGION_BYTES = 64 * 1024 * 1024
@@ -49,6 +49,16 @@ def _to_u8(data) -> np.ndarray:
 class _AnchoredBase(Fragmenter):
     def __init__(self, params: AnchoredCdcParams | None = None) -> None:
         self.params = params or AnchoredCdcParams()
+
+    def describe(self) -> dict:
+        p, c = self.params, self.params.chunk
+        return {"kind": "cdc-anchored",
+                "chunk": {"min_blocks": c.min_blocks,
+                          "avg_blocks": c.avg_blocks,
+                          "max_blocks": c.max_blocks,
+                          "strip_blocks": c.strip_blocks, "seed": c.seed},
+                "seg_min": p.seg_min, "seg_max": p.seg_max,
+                "seg_mask": p.seg_mask, "seed": p.seed}
 
     def manifest(self, data: bytes, name: str,
                  file_id: str | None = None) -> Manifest:
@@ -66,6 +76,15 @@ class AnchoredCpuFragmenter(_AnchoredBase):
     chunk_file_anchored_np, which tests enforce."""
 
     name = "cdc-anchored"
+
+    def __init__(self, params: AnchoredCdcParams | None = None,
+                 region_bytes: int = _REGION_BYTES) -> None:
+        super().__init__(params)
+        region_bytes = (int(region_bytes) // TILE_BYTES) * TILE_BYTES
+        if region_bytes < 2 * self.params.seg_max:
+            raise ValueError("region must hold at least two segments")
+        self.region_bytes = region_bytes
+        self.stride = region_bytes - self.params.seg_max
 
     def chunk(self, data: bytes) -> list[ChunkRef]:
         import hashlib
@@ -85,6 +104,94 @@ class AnchoredCpuFragmenter(_AnchoredBase):
         out = chunk_file_anchored_np(arr, self.params)
         return [ChunkRef(index=i, offset=o, length=ln, digest=dg)
                 for i, (o, ln, dg) in enumerate(out)]
+
+    def stream_span(self) -> int | None:
+        # one window resident; the carry can reach seg_max behind its base
+        return self.region_bytes + self.params.seg_max
+
+    def _region_spans(self, arr: np.ndarray, lookback: np.ndarray,
+                      start0: int, final: bool
+                      ) -> tuple[list[tuple[int, int]], int]:
+        from dfs_tpu.native import native_anchored_spans_region
+
+        out = native_anchored_spans_region(arr, lookback, start0, final,
+                                           self.params)
+        if out is None:
+            return region_spans_np(arr, lookback, start0, final,
+                                   self.params)
+        spans, consumed = out
+        return [(int(o), int(ln)) for o, ln in spans], consumed
+
+    def chunks_stream(self, blocks, store=None):
+        """Bounded-memory streaming on the HOST engine: the same
+        fixed-stride window walk as the device pipeline (windows advance
+        by region_bytes - seg_max; the unfinished tail segment carries),
+        run synchronously through dfs_anchored_spans_region (NumPy
+        region oracle when the toolchain is absent). Output is identical
+        to chunk() for any blocking — the window contract guarantees it.
+        Peak memory ~ one window regardless of stream length; the
+        reference reads the whole body into one array
+        (StorageNode.java:124)."""
+        import hashlib
+
+        buf = bytearray()
+        buf_base = 0                    # absolute offset of buf[0]
+        total = 0
+        base = 0                        # current window base (absolute)
+        start0 = 0                      # carry, window-local
+        idx = 0
+
+        def emit(spans: list[tuple[int, int]], b0: int) -> list[ChunkRef]:
+            nonlocal idx
+            out = []
+            for o, ln in spans:
+                off = b0 + o
+                payload = bytes(buf[off - buf_base:off - buf_base + ln])
+                dg = hashlib.sha256(payload).hexdigest()
+                out.append(ChunkRef(index=idx, offset=off, length=ln,
+                                    digest=dg))
+                idx += 1
+                if store is not None:
+                    store(dg, payload)
+            return out
+
+        def window(n: int, final: bool):
+            nonlocal base, start0, buf_base
+            lookback = np.zeros((8,), np.uint8)
+            take = min(8, base)
+            if take:
+                lb0 = base - take - buf_base
+                lookback[8 - take:] = np.frombuffer(
+                    buf, np.uint8, count=take, offset=lb0)
+            arr = np.frombuffer(buf, np.uint8, count=n,
+                                offset=base - buf_base)
+            spans, consumed = self._region_spans(arr, lookback, start0,
+                                                 final)
+            del arr                     # release before the bytearray trim
+            batch = emit(spans, base)
+            if not final:
+                start0 = consumed - self.stride
+                base += self.stride
+                keep_from = base - 8
+                if keep_from > buf_base:
+                    del buf[:keep_from - buf_base]
+                    buf_base = keep_from
+            return batch
+
+        for blk in blocks:
+            buf += blk
+            total += len(blk)
+            while total - base >= self.region_bytes:
+                batch = window(self.region_bytes, final=False)
+                if batch:
+                    yield batch
+        if total - base > 0 or total == 0:
+            batch = window(total - base, final=True)
+            if batch:
+                yield batch
+
+    def manifest_stream(self, blocks, name: str, store=None) -> Manifest:
+        return self._manifest_via_chunks_stream(blocks, name, store)
 
 
 class AnchoredTpuFragmenter(_AnchoredBase):
@@ -207,14 +314,21 @@ class AnchoredTpuFragmenter(_AnchoredBase):
     def chunk(self, data: bytes) -> list[ChunkRef]:
         return self._walk(_to_u8(data))
 
-    def manifest_stream(self, blocks, name: str, store=None) -> Manifest:
+    def stream_span(self) -> int | None:
+        # up to max_inflight windows dispatched-but-uncollected plus the
+        # one being filled; reporting lags by at most their total span
+        return self.region_bytes * (self.max_inflight + 1)
+
+    def chunks_stream(self, blocks, store=None):
         """Bounded-memory PIPELINED streaming: same fixed-stride window
         schedule and device-chained carry as chunk() (the two paths emit
         identical chunks by construction), dispatching each full window as
         soon as its bytes arrive while up to ``max_inflight`` windows
         compute. The host buffer is trimmed to the oldest un-collected
         window's base minus the 8-byte lookback, so peak memory is
-        ~(max_inflight + 1) windows regardless of stream length."""
+        ~(max_inflight + 1) windows regardless of stream length. Yields
+        each collected window's ChunkRefs as a batch (the sidecar's
+        incremental stream-stream surface)."""
         chunks: list[ChunkRef] = []
         buf = bytearray()
         buf_base = 0                   # absolute offset of buf[0]
@@ -239,8 +353,9 @@ class AnchoredTpuFragmenter(_AnchoredBase):
                 del buf[:keep_from - buf_base]
                 buf_base = keep_from
 
-        def advance(n_known: int, final_ok: bool) -> None:
-            """Dispatch every window whose bytes are fully buffered."""
+        def advance(n_known: int, final_ok: bool):
+            """Dispatch every window whose bytes are fully buffered;
+            yields a batch per collected window."""
             nonlocal base, start0, done
             while not done:
                 full = base + self.region_bytes <= n_known
@@ -248,8 +363,11 @@ class AnchoredTpuFragmenter(_AnchoredBase):
                 if not (full or final):
                     return
                 if len(pending) >= self.max_inflight:
+                    n0 = len(chunks)
                     self._collect_window(*pending.pop(0), fetch, chunks,
                                          store)
+                    if len(chunks) > n0:
+                        yield chunks[n0:]
                 win = self._dispatch_window(fetch, base, n_known, start0,
                                             final)
                 pending.append(win)
@@ -263,28 +381,28 @@ class AnchoredTpuFragmenter(_AnchoredBase):
         for blk in blocks:
             buf += blk
             total += len(blk)
-            advance(total, final_ok=False)
+            yield from advance(total, final_ok=False)
         if total == 0:
-            return Manifest(file_id=file_id_from_digests([]), name=name,
-                            size=0, fragmenter=self.name, chunks=())
+            return
         if total <= self.cpu_cutoff and not pending and base == 0:
             # small streams take chunk()'s oracle fast path (identical
             # output either way; this skips device dispatch entirely)
             cl = self._walk(np.frombuffer(buf, np.uint8), store=store)
-            return Manifest(
-                file_id=file_id_from_digests([c.digest for c in cl]),
-                name=name, size=total, fragmenter=self.name,
-                chunks=tuple(cl))
-        advance(total, final_ok=True)
+            if cl:
+                yield cl
+            return
+        yield from advance(total, final_ok=True)
         bound = 0
         while pending:
+            n0 = len(chunks)
             bound = self._collect_window(*pending.pop(0), fetch, chunks,
                                          store)
             trim()
+            if len(chunks) > n0:
+                yield chunks[n0:]
         if bound != total:
             raise AssertionError(
                 f"anchored stream ended at {bound} != {total}")
-        return Manifest(
-            file_id=file_id_from_digests([c.digest for c in chunks]),
-            name=name, size=total, fragmenter=self.name,
-            chunks=tuple(chunks))
+
+    def manifest_stream(self, blocks, name: str, store=None) -> Manifest:
+        return self._manifest_via_chunks_stream(blocks, name, store)

@@ -90,6 +90,12 @@ class CpuCdcFragmenter(Fragmenter):
         self.params = params or CDCParams()
         self.table = gear_table(self.params.seed)
 
+    def describe(self) -> dict:
+        p = self.params
+        return {"kind": "cdc", "min_size": p.min_size,
+                "avg_size": p.avg_size, "max_size": p.max_size,
+                "seed": p.seed}
+
     def bitmap_tile(self, arr: np.ndarray,
                     prev_g: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """Streaming tile kernel: (bitmap, new 31-entry Gear halo)."""

@@ -22,6 +22,9 @@ class FixedFragmenter(Fragmenter):
             raise ValueError("parts must be >= 1")
         self.parts = parts
 
+    def describe(self) -> dict:
+        return {"kind": "fixed", "parts": self.parts}
+
     def chunk(self, data: bytes) -> list[ChunkRef]:
         total = len(data)
         base, rem = divmod(total, self.parts)

@@ -84,9 +84,44 @@ class SidecarServer:
                                                 name="stream")
             return self._chunk_table(list(m.chunks), m.size)
 
+        def chunk_hash_duplex(request_iterator, ctx):
+            """stream-stream: chunk batches flow back AS the fragmenter's
+            walk finalizes them, instead of one table at stream end — the
+            node tees its body buffer and trims it against these replies,
+            which is what makes sidecar-delegated chunked uploads
+            bounded-memory on the node side (round-2 advisor finding: the
+            stream-unary path forced the node to hold the whole body)."""
+            from dfs_tpu.ops.cdc_v2 import file_id_from_digests
+
+            digests: list[str] = []
+            size = 0
+            for batch in self.fragmenter.chunks_stream(request_iterator):
+                if not batch:
+                    continue
+                size = batch[-1].offset + batch[-1].length
+                digests.extend(c.digest for c in batch)
+                yield json.dumps({
+                    "chunks": [{"index": c.index, "offset": c.offset,
+                                "length": c.length, "digest": c.digest}
+                               for c in batch]}).encode()
+            yield json.dumps({
+                "done": True, "size": size,
+                "fileId": file_id_from_digests(digests),
+                "fragmenter": self.fragmenter.name}).encode()
+
         def health(request: bytes, ctx) -> bytes:
+            # "window" = the fragmenter's reporting-lag bound (0 when the
+            # backend materializes): teeing duplex clients size their
+            # buffer cap from it — see SidecarFragmenter.chunks_stream
+            span = self.fragmenter.stream_span()
+            try:
+                desc = self.fragmenter.describe()
+            except NotImplementedError:
+                desc = None
             return json.dumps({"ok": True,
-                               "fragmenter": self.fragmenter.name}).encode()
+                               "fragmenter": self.fragmenter.name,
+                               "window": span or 0,
+                               "describe": desc}).encode()
 
         methods = {
             f"/{_SERVICE}/ChunkHash": grpc.unary_unary_rpc_method_handler(
@@ -95,6 +130,10 @@ class SidecarServer:
             f"/{_SERVICE}/ChunkHashStream":
                 grpc.stream_unary_rpc_method_handler(
                     chunk_hash_stream, request_deserializer=_identity,
+                    response_serializer=_identity),
+            f"/{_SERVICE}/ChunkHashDuplex":
+                grpc.stream_stream_rpc_method_handler(
+                    chunk_hash_duplex, request_deserializer=_identity,
                     response_serializer=_identity),
             f"/{_SERVICE}/Health": grpc.unary_unary_rpc_method_handler(
                 health, request_deserializer=_identity,
@@ -135,6 +174,9 @@ class SidecarClient:
         self._chunk_hash_stream = self._channel.stream_unary(
             f"/{_SERVICE}/ChunkHashStream", request_serializer=_identity,
             response_deserializer=_identity)
+        self._chunk_hash_duplex = self._channel.stream_stream(
+            f"/{_SERVICE}/ChunkHashDuplex", request_serializer=_identity,
+            response_deserializer=_identity)
         self._health = self._channel.unary_unary(
             f"/{_SERVICE}/Health", request_serializer=_identity,
             response_deserializer=_identity)
@@ -146,6 +188,13 @@ class SidecarClient:
         """Stream byte blocks (any iterable of bytes) — no size ceiling."""
         return json.loads(self._chunk_hash_stream(
             iter(blocks), timeout=self.timeout_s))
+
+    def chunk_hash_duplex(self, blocks):
+        """Stream blocks in, iterate chunk-batch dicts out as the sidecar
+        finalizes them; the last message is {'done': True, ...}."""
+        for msg in self._chunk_hash_duplex(iter(blocks),
+                                           timeout=self.timeout_s):
+            yield json.loads(msg)
 
     def health(self) -> dict:
         return json.loads(self._health(b"", timeout=self.health_timeout_s))
@@ -161,14 +210,25 @@ class SidecarFragmenter(Fragmenter):
     node's serving process — the north-star deployment shape ("the
     StorageNode calls the TPU backend over a local gRPC sidecar"). Streams
     in STREAM_BLOCK pieces, so payload size is unbounded on this side too.
-    manifest()/the store-callback streaming branch come from the base
-    class (the node runtime passes file_id explicitly, so no extra hashing
-    happens on the node path).
+    Store-callback streaming (the node's upload_stream path) rides the
+    duplex method with a capped tee buffer — bounded node memory; see
+    chunks_stream. manifest() comes from the base class (the node runtime
+    passes file_id explicitly, so no extra hashing happens there).
     """
 
     def __init__(self, port: int, host: str = "127.0.0.1") -> None:
         self.client = SidecarClient(port, host=host)
-        self.name = f"sidecar:{self.client.health()['fragmenter']}"
+        h = self.client.health()
+        self.name = f"sidecar:{h['fragmenter']}"
+        # reporting-lag bound of the sidecar's walk; 0 = materializing
+        # backend (fixed split) — then the tee cannot be safely capped
+        self.stream_window = int(h.get("window") or 0)
+        self._describe = h.get("describe")
+
+    def describe(self) -> dict:
+        if not self._describe:
+            raise NotImplementedError(f"{self.name} is not describable")
+        return self._describe
 
     def _refs(self, resp: dict):
         from dfs_tpu.meta.manifest import ChunkRef
@@ -182,17 +242,87 @@ class SidecarFragmenter(Fragmenter):
                   for i in range(0, len(data), STREAM_BLOCK))
         return list(self._refs(self.client.chunk_hash_stream(blocks)))
 
+    def chunks_stream(self, blocks, store=None):
+        """True streaming delegation over the duplex method: blocks are
+        TEED into a local rolling buffer while gRPC's sender thread
+        forwards them; each chunk batch the sidecar streams back is
+        sliced out of the tee (satisfying ``store``) and the buffer is
+        trimmed to the last reported chunk end. Peak node memory is
+        therefore ~the sidecar's in-flight window span plus transport
+        slack — never the whole body (``last_peak_buffer`` records the
+        high-water mark; tests assert the bound). gRPC flow control
+        paces the sender off the sidecar's walk, so TCP backpressure
+        still reaches the uploading client end to end."""
+        import threading
+
+        from dfs_tpu.meta.manifest import ChunkRef
+
+        cond = threading.Condition()
+        buf = bytearray()
+        base = 0                      # absolute offset of buf[0]
+        dead = False
+        self.last_peak_buffer = 0
+        # cap the un-trimmed tee at 2x the sidecar's advertised
+        # reporting-lag bound (gRPC's own flow control buffers multiple
+        # MB, so without this the tee grows to ~the whole body). 2x the
+        # lag bound can never deadlock: the sidecar always makes progress
+        # with at most `window` bytes outstanding past the last reported
+        # chunk end. A materializing backend advertises 0 -> uncapped.
+        budget = 2 * self.stream_window if self.stream_window else None
+
+        def tee():
+            for b in blocks:
+                bb = bytes(b)
+                with cond:
+                    while (budget is not None and not dead
+                           and len(buf) + len(bb) > budget + 2 * len(bb)):
+                        cond.wait(0.2)
+                    if dead:
+                        return
+                    buf.extend(bb)
+                    self.last_peak_buffer = max(self.last_peak_buffer,
+                                                len(buf))
+                yield bb
+
+        try:
+            for msg in self.client.chunk_hash_duplex(tee()):
+                if msg.get("done"):
+                    return
+                refs = []
+                for c in msg["chunks"]:
+                    ref = ChunkRef(index=c["index"], offset=c["offset"],
+                                   length=c["length"], digest=c["digest"])
+                    if store is not None:
+                        with cond:
+                            lo = ref.offset - base
+                            payload = bytes(buf[lo:lo + ref.length])
+                        if len(payload) != ref.length:
+                            raise RuntimeError(
+                                "sidecar chunk reply outran the teed stream")
+                        store(ref.digest, payload)
+                    refs.append(ref)
+                with cond:
+                    end = refs[-1].offset + refs[-1].length
+                    if end > base:
+                        del buf[:end - base]
+                        base = end
+                    cond.notify_all()
+                yield refs
+        finally:
+            with cond:
+                dead = True           # unblock a tee stuck at the cap
+                cond.notify_all()
+
     def manifest_stream(self, blocks, name: str, store=None):
         from dfs_tpu.meta.manifest import Manifest
 
-        if store is not None:
-            # store callbacks need the chunk bytes — the base fallback
-            # materializes; the node runtime never passes store
-            return super().manifest_stream(blocks, name=name, store=store)
-        resp = self.client.chunk_hash_stream(blocks)
-        return Manifest(file_id=resp["fileId"], name=name,
-                        size=resp["size"], fragmenter=self.name,
-                        chunks=self._refs(resp))
+        if store is None:
+            # metadata-only callers skip the tee copy entirely
+            resp = self.client.chunk_hash_stream(blocks)
+            return Manifest(file_id=resp["fileId"], name=name,
+                            size=resp["size"], fragmenter=self.name,
+                            chunks=self._refs(resp))
+        return self._manifest_via_chunks_stream(blocks, name, store)
 
     def close(self) -> None:
         self.client.close()

@@ -70,6 +70,18 @@ def binary(status: int, data: bytes, filename: str) -> bytes:
                  {"Content-Disposition": f'attachment; filename="{safe}"'})
 
 
+def binary_head(status: int, length: int, filename: str) -> bytes:
+    """Response head only (Content-Length known upfront from the
+    manifest) — the body streams behind it chunk by chunk."""
+    safe = "".join(c for c in filename if c >= " " and c != '"') or "download"
+    head = [f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}",
+            "Content-Type: application/octet-stream",
+            f"Content-Length: {length}",
+            "Connection: close",
+            f'Content-Disposition: attachment; filename="{safe}"']
+    return ("\r\n".join(head) + "\r\n\r\n").encode()
+
+
 def _parse_range(value: str) -> tuple[int | None, int | None] | None:
     """Parse a single-range ``bytes=`` header into (first, last) with
     either side possibly open: 'bytes=a-b' -> (a, b), 'bytes=a-' ->
@@ -124,8 +136,11 @@ def make_http_handler(node: "StorageNodeServer"):
     async def handler(reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         t0 = time.perf_counter()
+        body_gen = None
         try:
             out = await _serve_one(node, reader)
+            if isinstance(out, tuple):          # streamed body
+                out, body_gen = out
         except Exception as e:  # noqa: BLE001
             node.log.warning("http error: %s", e)
             out = plain(500, f"Internal error: {e}")
@@ -133,9 +148,24 @@ def make_http_handler(node: "StorageNodeServer"):
         try:
             writer.write(out)
             await writer.drain()
+            if body_gen is not None:
+                try:
+                    async for part in body_gen:
+                        writer.write(part)
+                        await writer.drain()    # socket backpressure
+                except Exception as e:  # noqa: BLE001
+                    # head already sent: the only honest signal left is
+                    # truncation (close before Content-Length is met) —
+                    # never pad a corrupt/incomplete body to completion
+                    node.log.warning("download stream aborted: %s", e)
         except (ConnectionError, OSError):
             pass
         finally:
+            if body_gen is not None:
+                try:
+                    await body_gen.aclose()
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
             writer.close()
             try:
                 await writer.wait_closed()
@@ -208,6 +238,71 @@ async def _serve_one(node: "StorageNodeServer",
             return plain(404, "File not found")
         return _resp(200, m.to_json().encode(), "application/json")
 
+    if method == "GET" and path == "/chunking":
+        # resumable-upload probe step 1: parameters sufficient for the
+        # client to reproduce chunk boundaries bit-exactly (CPU/TPU/
+        # sidecar engines chunk identically by construction)
+        try:
+            desc = node.fragmenter.describe()
+        except NotImplementedError:
+            return plain(404, "Fragmenter not resume-describable")
+        return as_json(200, {"fragmenter": node.fragmenter.name,
+                             "describe": desc})
+
+    if method == "POST" and path == "/missing":
+        if content_length is None:
+            return plain(411, "Length Required")
+        if content_length > 64 * 1024 * 1024:
+            return plain(413, "Payload Too Large")
+        try:
+            digests = json.loads(await reader.readexactly(content_length))
+            if (not isinstance(digests, list)
+                    or not all(isinstance(d, str) for d in digests)):
+                raise ValueError("want a JSON list of digest strings")
+        except (ValueError, UnicodeDecodeError) as e:
+            return plain(400, f"Bad digest list: {e}")
+        return as_json(200,
+                       {"missing": await node.missing_digests(digests)})
+
+    if method == "POST" and path == "/upload_resume":
+        # body: [u32 json_len][json {fileId,size,chunks,provided}]
+        # [provided payloads concatenated in listed order]
+        if content_length is None:
+            return plain(411, "Length Required")
+        if content_length > MAX_BODY:
+            return plain(413, "Payload Too Large")
+        raw = await reader.readexactly(content_length)
+        try:
+            jlen = int.from_bytes(raw[:4], "big")
+            meta = json.loads(raw[4:4 + jlen])
+            table = [(int(o), int(ln), str(dg))
+                     for o, ln, dg in meta["chunks"]]
+            lengths = {dg: ln for _, ln, dg in table}
+            provided: dict[str, bytes] = {}
+            off = 4 + jlen
+            for dg in meta["provided"]:
+                ln = lengths[dg]
+                provided[dg] = raw[off:off + ln]
+                off += ln
+            if off != len(raw):
+                raise ValueError("payload section length mismatch")
+            file_id, size = str(meta["fileId"]), int(meta["size"])
+        except (KeyError, ValueError, TypeError) as e:
+            return plain(400, f"Bad resume frame: {e}")
+        if _bad_id(file_id):
+            return plain(400, "Bad fileId")
+        try:
+            manifest, stats = await node.upload_resume(
+                table, query.get("name", ""), file_id, size, provided)
+        except UploadError as e:
+            # 409 = resume no longer possible (client falls back to a
+            # full upload); 400 = bad frame/table; 500 = placement failed
+            return plain(e.status, str(e))
+        return as_json(201, {"fileId": manifest.file_id,
+                             "name": manifest.name,
+                             "size": manifest.size,
+                             "chunks": manifest.total_chunks, **stats})
+
     if method == "POST" and path == "/upload":
         if chunked:
             # streaming ingest: the chunked-transfer body feeds the
@@ -274,12 +369,18 @@ async def _serve_one(node: "StorageNodeServer",
                     {"Content-Range":
                      f"bytes {start}-{end - 1}/{manifest.size}",
                      "Accept-Ranges": "bytes"})
-            manifest, data = await node.download(file_id)
+            # STREAMING read: chunks go to the socket as they verify —
+            # node memory stays ~one fetch batch for any file size (the
+            # reference assembles the whole file in RAM before replying,
+            # StorageNode.java:419,448; its heap bounds usable file
+            # size). The first batch is fetched before the head is
+            # written, so the common failures still answer 404/500.
+            manifest, body_gen = await node.download_stream(file_id)
         except NotFoundError:
             return plain(404, "File not found")
         except DownloadError as e:
             return plain(500, str(e))
-        return binary(200, data, manifest.name)
+        return binary_head(200, manifest.size, manifest.name), body_gen
 
     if method == "POST" and path == "/scrub":
         # verify every local chunk against its content address; corrupt

@@ -43,7 +43,14 @@ from dfs_tpu.utils.trace import LatencyRecorder, span
 
 
 class UploadError(RuntimeError):
-    """Maps to HTTP 500 'Replication failed' (StorageNode.java:176)."""
+    """Maps to HTTP 500 'Replication failed' (StorageNode.java:176) by
+    default; raisers may pin a different code via ``status`` (resume
+    validation -> 400, resume-missing-chunks -> 409) so the HTTP layer
+    never classifies by matching message text."""
+
+    def __init__(self, msg: str, status: int = 500) -> None:
+        super().__init__(msg)
+        self.status = status
 
 
 class NotFoundError(KeyError):
@@ -385,6 +392,125 @@ class StorageNodeServer:
         stats["uniqueChunks"] = len(seen)
         await self._finalize_upload(manifest)
         self.counters.inc("upload_bytes", total)
+        return manifest, stats
+
+    async def missing_digests(self, digests: list[str]) -> list[str]:
+        """Which of ``digests`` the cluster holds NOwhere reachable —
+        the resumable-upload probe (SURVEY §5.4: chunk-level resume falls
+        out of the dedup index). Local CAS first; the remainder is asked
+        of each digest's replica set via batched has_chunks."""
+        missing = [d for d in dict.fromkeys(digests)
+                   if is_hex_digest(d) and not self.store.chunks.has(d)]
+        if not missing:
+            return []
+        ids = self.cfg.cluster.sorted_ids()
+        rf = self.cfg.cluster.replication_factor
+        found: set[str] = set()
+        by_peer: dict[int, list[str]] = {}
+        for d in missing:
+            for t in replica_set(d, ids, rf):
+                if t != self.cfg.node_id:
+                    by_peer.setdefault(t, []).append(d)
+
+        async def probe(nid: int, ds: list[str]) -> None:
+            try:
+                resp, _ = await self.client.call(
+                    self.cfg.cluster.peer(nid),
+                    {"op": "has_chunks", "digests": ds}, retries=1)
+                found.update(resp.get("have", []))
+            except RpcError:
+                pass
+
+        await asyncio.gather(*(probe(n, ds) for n, ds in by_peer.items()))
+        return [d for d in missing if d not in found]
+
+    async def upload_resume(self, table: list[tuple[int, int, str]],
+                            name: str, file_id: str, size: int,
+                            provided: dict[str, bytes]
+                            ) -> tuple[Manifest, dict]:
+        """Finalize an upload from a client-supplied chunk table plus
+        ONLY the payloads the cluster lacked (client flow: GET /chunking
+        -> chunk locally -> POST /missing -> POST /upload_resume). The
+        interrupted-upload bytes already placed are never re-sent — the
+        resume SURVEY §5.4 says should fall out of the dedup index.
+
+        Integrity: every provided payload is hash-verified; chunks NOT
+        provided must be locally present or fetchable from replicas
+        (else UploadError lists them — client falls back to a full
+        upload); the assembled stream must hash to ``file_id`` exactly
+        like a regular upload's fileId = sha256(body)."""
+        if not name:
+            name = f"file-{file_id[:8]}"   # reference default naming
+        # table sanity: contiguous tiling of [0, size)
+        expect = 0
+        for off, ln, dg in table:
+            if off != expect or ln < 0 or not is_hex_digest(dg):
+                raise UploadError("malformed chunk table", status=400)
+            expect = off + ln
+        if expect != size:
+            raise UploadError("chunk table does not tile the stream",
+                              status=400)
+
+        hexes = await asyncio.to_thread(
+            sha256_many_hex, list(provided.values()))
+        for d, h in zip(provided, hexes):
+            if d != h:
+                raise UploadError(f"provided chunk {d[:12]}… hash mismatch",
+                                  status=400)
+
+        refs = [ChunkRef(index=i, offset=off, length=ln, digest=dg)
+                for i, (off, ln, dg) in enumerate(table)]
+        manifest = Manifest(file_id=file_id, name=name, size=size,
+                            fragmenter=self.fragmenter.name,
+                            chunks=tuple(refs))
+
+        # assemble incrementally (batches) to verify the whole-stream
+        # hash AND place everything; bytes come from `provided`, the
+        # local CAS, or replicas
+        import hashlib
+
+        stats = self._new_upload_stats()
+        stats["bytes"] = sum(len(b) for b in provided.values())
+        hasher = hashlib.sha256()
+        seen: set[str] = set()
+        batch: list = []
+        bsize = 0
+        for c in refs:
+            batch.append(c)
+            bsize += c.length
+            if bsize >= self._FETCH_BATCH_BYTES or c is refs[-1]:
+                got = dict(provided)
+                need = [x for x in batch if x.digest not in got]
+                if need:
+                    # digest-verified like every read path: a rotten
+                    # local copy of an interrupted upload's chunk heals
+                    # from a replica instead of failing the resume with
+                    # a client-blaming hash error forever
+                    fetched = await self._fetch_verified(
+                        manifest, need, strict=False)
+                    got.update(fetched)
+                absent = [x.digest for x in batch if x.digest not in got]
+                if absent:
+                    raise UploadError(
+                        "resume missing chunks: "
+                        + ",".join(d[:12] for d in absent), status=409)
+                payloads = [got[x.digest] for x in batch]
+                await asyncio.to_thread(
+                    lambda ps=payloads: [hasher.update(p) for p in ps])
+                place = [(x.digest, got[x.digest]) for x in batch
+                         if x.digest not in seen]
+                seen.update(d for d, _ in place)
+                await self._place_batch(file_id, place, stats)
+                batch, bsize = [], 0
+        if hasher.hexdigest() != file_id:
+            raise UploadError("resumed stream does not hash to fileId",
+                              status=400)
+        stats["uniqueChunks"] = len(seen)
+        if stats["minCopies"] is None:
+            stats["minCopies"] = self.cfg.cluster.replication_factor
+        await self._finalize_upload(manifest)
+        self.counters.inc("uploads_resumed")
+        self.counters.inc("upload_bytes", size)
         return manifest, stats
 
     @staticmethod
@@ -777,11 +903,26 @@ class StorageNodeServer:
 
         wanted = [c for c in manifest.chunks
                   if c.offset < end and c.offset + c.length > start]
-        # verify local copies ONCE, off the event loop, and hand the
-        # verified bytes to the gather (reading + hashing them inline and
-        # re-reading in the gather would double the disk I/O and stall
-        # every other request for the duration of a big range)
-        digests = list(dict.fromkeys(c.digest for c in wanted))
+        # local copies are verified ONCE, off the event loop, inside
+        # _fetch_verified (the whole-file hash gate cannot apply to a
+        # partial read, so per-chunk verification carries integrity)
+        by_digest = await self._fetch_verified(manifest, wanted)
+        parts = []
+        for c in wanted:
+            b = by_digest[c.digest]
+            lo = max(0, start - c.offset)
+            hi = min(c.length, end - c.offset)
+            parts.append(b[lo:hi])
+        self.counters.inc("range_downloads")
+        return manifest, b"".join(parts), start, end
+
+    async def _fetch_verified(self, manifest: Manifest, chunks: list,
+                              strict: bool = True) -> dict[str, bytes]:
+        """Gather a slice of a manifest's chunks with local copies
+        digest-verified first (heal-on-read: rotten local chunks are
+        evicted + queued for repair and re-fetched from replicas, the
+        same discipline range reads use)."""
+        digests = list(dict.fromkeys(c.digest for c in chunks))
         local = await asyncio.to_thread(
             lambda: [(d, b) for d in digests
                      if (b := self.store.chunks.get(d)) is not None])
@@ -794,18 +935,73 @@ class StorageNodeServer:
             else:
                 self.store.chunks.delete(d)
                 self.under_replicated.add(d)
-                self.log.warning("evicted corrupt local chunk %s on "
-                                 "range read", d[:12])
-        by_digest = await self._gather_chunks(manifest, chunks=wanted,
-                                              prefetched=good)
-        parts = []
-        for c in wanted:
-            b = by_digest[c.digest]
-            lo = max(0, start - c.offset)
-            hi = min(c.length, end - c.offset)
-            parts.append(b[lo:hi])
-        self.counters.inc("range_downloads")
-        return manifest, b"".join(parts), start, end
+                self.log.warning("evicted corrupt local chunk %s on read",
+                                 d[:12])
+        return await self._gather_chunks(manifest, chunks=chunks,
+                                         prefetched=good, strict=strict)
+
+    async def download_stream(self, file_id: str):
+        """Streaming read: -> (manifest, async generator of chunk
+        payloads in stream order). Chunks are gathered in ~32 MiB batches
+        and yielded as they verify, so node memory stays ~one batch no
+        matter the file size — the reference (and this node's download()
+        until round 3) assembles the whole file in RAM
+        (StorageNode.java:419,448). Integrity: every chunk is
+        digest-verified (local AND remote); the reference's whole-file
+        gate (sha256(assembled) == fileId, StorageNode.java:453-458) is
+        kept by hashing incrementally and HOLDING BACK the final chunk —
+        a corrupted assembly is truncated before its last byte, never
+        silently completed. The first batch is fetched eagerly so
+        unrecoverable-chunk failures surface before any byte is sent."""
+        import hashlib
+
+        manifest = await self._resolve_manifest(file_id)
+        refs = list(manifest.chunks)
+        batches: list[list] = []
+        cur: list = []
+        size = 0
+        for c in refs:
+            cur.append(c)
+            size += c.length
+            if size >= self._FETCH_BATCH_BYTES:
+                batches.append(cur)
+                cur, size = [], 0
+        if cur:
+            batches.append(cur)
+        first = await self._fetch_verified(manifest, batches[0]) \
+            if batches else {}
+
+        async def gen():
+            nonlocal first
+            hasher = hashlib.sha256()
+            held: bytes | None = None
+            total = 0
+            for i, batch in enumerate(batches):
+                if i:
+                    got = await self._fetch_verified(manifest, batch)
+                else:
+                    got, first = first, None   # don't pin batch 0 for the
+                    # whole download — peak stays ~one batch
+                payloads = [got[c.digest] for c in batch]
+                await asyncio.to_thread(
+                    lambda ps=payloads: [hasher.update(p) for p in ps])
+                for b in payloads:
+                    if held is not None:
+                        total += len(held)
+                        yield held
+                    held = b
+            if hasher.hexdigest() != file_id:
+                # mid-assembly corruption (e.g. a stale manifest): abort
+                # before the last byte — the client sees truncation, not
+                # a silently wrong file
+                raise DownloadError("File corrupted")
+            if held is not None:
+                total += len(held)
+                yield held
+            self.counters.inc("downloads")
+            self.counters.inc("download_bytes", total)
+
+        return manifest, gen()
 
     async def download(self, file_id: str) -> tuple[Manifest, bytes]:
         manifest = await self._resolve_manifest(file_id)

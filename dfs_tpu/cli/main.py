@@ -128,7 +128,15 @@ def cmd_list(args) -> int:
 
 def cmd_upload(args) -> int:
     path = Path(args.file)
-    info = _client(args).upload(path.read_bytes(), name=path.name)
+    data = path.read_bytes()
+    if getattr(args, "resume", False):
+        # chunk locally, probe, send only missing payloads (SURVEY §5.4)
+        info = _client(args).upload_resume(data, name=path.name)
+        print(f"Uploaded (resume): fileId={info['fileId']} "
+              f"chunks={info['chunks']} "
+              f"clientSent={info['clientBytesSent']}B of {len(data)}B")
+        return 0
+    info = _client(args).upload(data, name=path.name)
     print(f"Uploaded: fileId={info['fileId']} chunks={info['chunks']} "
           f"transferred={info.get('transferredBytes', '?')}B "
           f"dedupSkipped={info.get('dedupSkippedBytes', '?')}B")
@@ -273,6 +281,8 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("list").set_defaults(fn=cmd_list)
     up = sub.add_parser("upload")
     up.add_argument("file")
+    up.add_argument("--resume", action="store_true",
+                    help="probe the cluster and send only missing chunks")
     up.set_defaults(fn=cmd_upload)
     down = sub.add_parser("download")
     down.add_argument("file_id")

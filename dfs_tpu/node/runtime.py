@@ -687,6 +687,15 @@ class StorageNodeServer:
                       if t != self.cfg.node_id]
         # try believed-alive replicas first; dead ones remain as last resort
         candidates.sort(key=lambda t: not self.health.is_alive(t))
+        # then every OTHER peer (alive-first too): after a membership
+        # change the mod-N replica set remaps, but the bytes still live
+        # on the old holders until repair migrates them (see README on
+        # rebalance) — and a known-dead peer ahead of a live holder
+        # would cost a connect timeout per chunk
+        candidates += sorted(
+            (t for t in ids
+             if t != self.cfg.node_id and t not in candidates),
+            key=lambda t: not self.health.is_alive(t))
         for target in candidates:
             try:
                 data = await self.client.get_chunk(
@@ -828,11 +837,41 @@ class StorageNodeServer:
                 break
             await asyncio.gather(*(fetch_batches(nid, ds)
                                    for nid, ds in by_peer.items()))
-        missing = [d for d in need if d not in out]
 
-        # terminal per-chunk path: only chunks NO replica produced valid
-        # bytes for reach here — walks candidates once more, then raises
-        # (strict) or skips (repair's best-effort)
+        # cluster-wide fallback: after a MEMBERSHIP CHANGE the mod-N
+        # replica sets remap wholesale while the bytes still sit on the
+        # old holders until repair migrates them. One cheap batched
+        # has_chunks to every peer finds the actual holders, then one
+        # batched fetch per claiming peer — no duplicate payload
+        # transfer, and reads stay correct throughout a rebalance.
+        missing = [d for d in need if d not in out]
+        if missing:
+            claims: dict[str, int] = {}
+
+            async def who_has(nid: int) -> None:
+                try:
+                    resp, _ = await self.client.call(
+                        self.cfg.cluster.peer(nid),
+                        {"op": "has_chunks", "digests": missing},
+                        retries=1)
+                    for d in resp.get("have", []):
+                        claims.setdefault(d, nid)
+                except RpcError:
+                    pass
+
+            others = [p.node_id for p in self._peers()]
+            await asyncio.gather(*(who_has(n) for n in others))
+            groups2: dict[int, list[str]] = {}
+            for d, nid in claims.items():
+                groups2.setdefault(nid, []).append(d)
+            if groups2:
+                await asyncio.gather(*(fetch_batches(nid, ds)
+                                       for nid, ds in groups2.items()))
+
+        # terminal per-chunk path: only chunks NO reachable peer produced
+        # valid bytes for reach here — walks candidates once more, then
+        # raises (strict) or skips (repair's best-effort)
+        missing = [d for d in need if d not in out]
         if missing:
             sem = asyncio.Semaphore(8)
 
@@ -1229,6 +1268,7 @@ class StorageNodeServer:
         swept = self.store.gc(min_age_s=3600.0)
         if swept:
             self.log.info("gc: swept %d aged orphan chunks", len(swept))
+        self.counters.inc("repairs")
         return repaired
 
     async def scrub_once(self) -> dict:

@@ -32,8 +32,9 @@ from dfs_tpu.meta.manifest import ChunkRef, Manifest
 from dfs_tpu.ops.cdc_anchored import (TILE_BYTES, AnchoredCdcParams,
                                       CutCapacityOverflow,
                                       chunk_file_anchored_np, region_buffer,
-                                      region_chunks, region_collect,
-                                      region_dispatch, region_spans_np)
+                                      region_buffer_size, region_chunks,
+                                      region_collect, region_dispatch,
+                                      region_spans_np)
 from dfs_tpu.ops.cdc_v2 import file_id_from_digests
 
 _REGION_BYTES = 64 * 1024 * 1024
@@ -215,6 +216,11 @@ class AnchoredTpuFragmenter(_AnchoredBase):
         self.cpu_cutoff = int(cpu_cutoff)
         self.lane_multiple = int(lane_multiple)
         self.max_inflight = max(1, int(max_inflight))
+        # recycled host staging buffers, keyed by byte size: fresh 64 MiB
+        # allocations measured a large one-time transfer setup cost per
+        # buffer on some host->device links; a buffer returns to the pool
+        # at collect time, when its transfer has certainly completed
+        self._buf_pool: dict[int, list[np.ndarray]] = {}
 
     # -- pipelined region walk shared by chunk() and manifest_stream() ----
 
@@ -236,17 +242,34 @@ class AnchoredTpuFragmenter(_AnchoredBase):
         take = min(8, base)
         if take:
             lookback[8 - take:] = fetch(base - take, take)
-        words = jax.device_put(region_buffer(
-            fetch(base, end - base), lookback, self.params))
+        staged = region_buffer(fetch(base, end - base), lookback,
+                               self.params, out=self._pool_take(end - base))
+        words = jax.device_put(staged)
         out = region_dispatch(words, end - base, start0, final,
                               self.params, lane_multiple=self.lane_multiple)
-        return base, end, final, out
+        return base, end, final, out, staged
 
-    def _collect_window(self, base: int, end: int, final: bool, out, fetch,
+    def _pool_take(self, n: int) -> np.ndarray | None:
+        # list.pop() is atomic under the GIL; try/except (not
+        # check-then-pop) keeps concurrent walks on a shared fragmenter
+        # from racing each other to the last free buffer
+        try:
+            return self._buf_pool[region_buffer_size(n, self.params)].pop()
+        except (KeyError, IndexError):
+            return None
+
+    def _pool_give(self, staged: np.ndarray) -> None:
+        buf = staged.view(np.uint8)
+        self._buf_pool.setdefault(buf.shape[0], []).append(buf)
+
+    def _collect_window(self, base: int, end: int, final: bool, out,
+                        staged, fetch,
                         chunks: list[ChunkRef], store) -> int:
         """Pull one window's results, append absolute-offset ChunkRefs;
         returns the absolute consumed bound. Verifies span contiguity (the
-        device-chained carry has no per-region host check)."""
+        device-chained carry has no per-region host check). The window's
+        host staging buffer returns to the pool here — its transfer has
+        certainly completed once the outputs are readable."""
         expect = chunks[-1].offset + chunks[-1].length if chunks else 0
         try:
             spans, consumed = region_collect(out)
@@ -263,6 +286,7 @@ class AnchoredTpuFragmenter(_AnchoredBase):
                 fetch(base, end - base), lookback, expect - base, final,
                 self.params, lane_multiple=self.lane_multiple,
                 cap_mode="full")
+        self._pool_give(staged)
         for o, ln, dg in spans:
             off = base + o
             if off != expect:

@@ -609,19 +609,41 @@ def make_anchored_segment_fn(params: AnchoredCdcParams, m_words: int,
 # host driver: one resident batch -> chunk table
 # ---------------------------------------------------------------------------
 
+def region_buffer_size(n: int, params: AnchoredCdcParams,
+                       m_words: int | None = None) -> int:
+    """Byte size of the staging buffer :func:`region_buffer` builds for an
+    ``n``-byte region — the single place the layout math lives (callers
+    pooling buffers must agree with it exactly)."""
+    if m_words is None:
+        m_words = next_pow2(-(-n // TILE_BYTES)) * (TILE_BYTES // 4)
+    return 8 + m_words * 4 + params.seg_max + 4
+
+
 def region_buffer(data: np.ndarray, lookback: np.ndarray,
                   params: AnchoredCdcParams,
-                  m_words: int | None = None) -> np.ndarray:
+                  m_words: int | None = None,
+                  out: np.ndarray | None = None) -> np.ndarray:
     """Host-side staging buffer for one region:
     [8 lookback bytes][region padded to whole tiles] plus one full lane +
     funnel word of slack so every lane's dynamic_slice stays in bounds
     (jax clamps out-of-range slice starts, which would silently shift a
     tail segment's content). Returned as the LE u32 view device_put wants.
-    Pass ``m_words`` to pin the shape (one compile across a region walk)."""
+    Pass ``m_words`` to pin the shape (one compile across a region walk);
+    pass ``out`` (a u8 buffer of exactly the right size, e.g. from a
+    previous call) to fill in place — fresh 64 MiB allocations pay a
+    large one-time host->device transfer setup on some links, so the
+    pipelined walk recycles buffers once their transfer completed."""
     n = int(data.shape[0])
+    total = region_buffer_size(n, params, m_words=m_words)
     if m_words is None:
         m_words = next_pow2(-(-n // TILE_BYTES)) * (TILE_BYTES // 4)
-    buf = np.zeros((8 + m_words * 4 + params.seg_max + 4,), dtype=np.uint8)
+    if out is None:
+        buf = np.zeros((total,), dtype=np.uint8)
+    else:
+        if out.shape[0] != total or out.dtype != np.uint8:
+            raise ValueError("recycled buffer has the wrong shape")
+        buf = out
+        buf[8 + n:] = 0
     buf[:8] = lookback
     buf[8:8 + n] = data
     return buf.view("<u4")

@@ -895,6 +895,64 @@ def test_corrupt_chunk_detected(tmp_path, rng):
     asyncio.run(run())
 
 
+def test_membership_growth_rebalances(tmp_path, rng):
+    """Grow a 4-node cluster to 5: mod-N placement remaps most chunks,
+    so (a) reads must stay correct THROUGHOUT via the cluster-wide
+    holder fallback (the new replica set may hold nothing yet), and
+    (b) repair must converge placement — every chunk lands on its NEW
+    replica set. The reference is frozen at N=5 (StorageNode.java:15);
+    rebalance cost of mod-N vs a ring is documented in README."""
+    data1 = rng.integers(0, 256, size=60_000, dtype=np.uint8).tobytes()
+    data2 = rng.integers(0, 256, size=45_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        from dfs_tpu.node.placement import replica_set
+
+        cluster4 = make_cluster_cfg(4)
+        nodes = await start_nodes(cluster4, tmp_path,
+                                  retries=1, connect_timeout_s=0.3)
+        m1, _ = await nodes[1].upload(data1, "a.bin")
+        m2, _ = await nodes[2].upload(data2, "b.bin")
+        await stop_nodes(nodes)
+
+        # same peers 1-4 (same ports, same data roots) + a new node 5
+        new_ports = _free_ports(2)
+        cluster5 = ClusterConfig(
+            peers=cluster4.peers + (PeerAddr(
+                node_id=5, host="127.0.0.1", port=new_ports[0],
+                internal_port=new_ports[1]),),
+            replication_factor=cluster4.replication_factor)
+        nodes = await start_nodes(cluster5, tmp_path,
+                                  retries=1, connect_timeout_s=0.3)
+        try:
+            # reads correct IMMEDIATELY — including from the empty new
+            # node, whose remapped replica sets mostly miss
+            _, got = await nodes[5].download(m1.file_id)
+            assert got == data1
+            _, got = await nodes[3].download(m2.file_id)
+            assert got == data2
+
+            # repair converges canonical placement for the new topology
+            for n in nodes.values():
+                await n.repair_once()
+            ids = cluster5.sorted_ids()
+            rf = cluster5.replication_factor
+            for m in (nodes[1].store.manifests.load(m1.file_id),
+                      nodes[1].store.manifests.load(m2.file_id)):
+                for c in m.chunks:
+                    for t in replica_set(c.digest, ids, rf):
+                        assert nodes[t].store.chunks.has(c.digest), \
+                            f"{c.digest[:8]} not yet on node {t}"
+
+            # and reads still byte-identical after the rebalance
+            _, got = await nodes[5].download(m1.file_id)
+            assert got == data1
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
 def test_manifest_fallback_from_peers(tmp_path, rng):
     """A node that never saw the announce can still serve the download by
     pulling the manifest from peers (fixes reference silent-loss, §5.3)."""

@@ -109,7 +109,18 @@ class CpuCdcFragmenter(Fragmenter):
 
     def cuts(self, data: bytes | np.ndarray) -> np.ndarray:
         arr = np.frombuffer(data, dtype=np.uint8) if isinstance(
-            data, (bytes, bytearray, memoryview)) else data
+            data, (bytes, bytearray, memoryview)) else np.ascontiguousarray(
+            data, dtype=np.uint8)   # C++ reads raw base-pointer bytes
+        from dfs_tpu.native import native_gear_cuts
+
+        # C++ sequential engine when the toolchain is available (bit-
+        # identical to the NumPy path below — tests/test_native.py); the
+        # NumPy bitmap+select pair measured minutes per GiB
+        native = native_gear_cuts(arr, self.table, self.params.mask,
+                                  self.params.min_size,
+                                  self.params.max_size)
+        if native is not None:
+            return native
         bitmap = gear_bitmap_numpy(arr, self.table, self.params.mask)
         return select_cuts(bitmap, arr.shape[0],
                            self.params.min_size, self.params.max_size)

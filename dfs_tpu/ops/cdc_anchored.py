@@ -635,8 +635,6 @@ def region_buffer(data: np.ndarray, lookback: np.ndarray,
     pipelined walk recycles buffers once their transfer completed."""
     n = int(data.shape[0])
     total = region_buffer_size(n, params, m_words=m_words)
-    if m_words is None:
-        m_words = next_pow2(-(-n // TILE_BYTES)) * (TILE_BYTES // 4)
     if out is None:
         buf = np.zeros((total,), dtype=np.uint8)
     else:

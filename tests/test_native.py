@@ -33,11 +33,21 @@ def test_native_gear_cuts_match_spec(rng):
 
 
 def test_native_matches_numpy_fragmenter(rng):
+    # compare against the NumPy bitmap+select pair DIRECTLY: frag.cuts()
+    # itself routes through the native engine when available, which
+    # would make this a tautology and leave the fallback untested
+    from dfs_tpu.fragmenter.cdc_cpu import gear_bitmap_numpy
+    from dfs_tpu.ops.boundary import select_cuts
+
     data = rng.integers(0, 256, size=80_000, dtype=np.uint8).tobytes()
     frag = CpuCdcFragmenter(PARAMS)
     got = native_gear_cuts(data, frag.table, PARAMS.mask,
                            PARAMS.min_size, PARAMS.max_size)
-    assert got.tolist() == frag.cuts(data).tolist()
+    arr = np.frombuffer(data, dtype=np.uint8)
+    bitmap = gear_bitmap_numpy(arr, frag.table, PARAMS.mask)
+    want = select_cuts(bitmap, arr.shape[0],
+                       PARAMS.min_size, PARAMS.max_size)
+    assert got.tolist() == want.tolist()
 
 
 def test_native_anchored_spans_matches_oracle(rng):

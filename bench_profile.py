@@ -55,7 +55,7 @@ def main() -> int:
     words = jax.device_put(region_buffer(data, np.zeros((8,), np.uint8),
                                          params))
 
-    m_words = int(words.shape[0]) - 2 - (params.seg_max + 4) // 4
+    m_words = A.recover_m_words(int(words.shape[0]), params)
     m_tiles = m_words * 4 // A.TILE_BYTES
     cap = m_words * 4 // params.seg_min + 1
     s_pad = -(-cap // 128) * 128

@@ -218,6 +218,7 @@ def make_anchored_step(mesh: Mesh, params):
     from dfs_tpu.ops.cdc_v2 import (gear_candidates_device,
                                     select_cuts_device)
     from dfs_tpu.ops.layout import bswap_transpose
+    from dfs_tpu.ops.repack import repack_lanes_xla
     from dfs_tpu.ops.sha256_strip import strip_states, strip_states_xla
 
     cp = params.chunk
@@ -225,12 +226,9 @@ def make_anchored_step(mesh: Mesh, params):
     on_tpu = all(d.platform == "tpu" for d in mesh.devices.flat)
 
     def local_step(words, w_off, sh8, real_blocks):
-        x = jax.vmap(lambda o: jax.lax.dynamic_slice(
-            words, (o,), (lane_words + 1,)))(w_off)
-        sh = sh8[:, None]
-        packed = jnp.where(
-            sh == 0, x[:, :-1],
-            (x[:, :-1] >> sh) | (x[:, 1:] << (jnp.uint32(32) - sh)))
+        # XLA repack form inside shard_map (per-shard Pallas dispatch is
+        # not worth gating here); ops.repack owns the single definition
+        packed = repack_lanes_xla(words, w_off, sh8, lane_words)
         words_t = bswap_transpose(packed)
         cand = gear_candidates_device(words_t, cp)
         cutflag, since = select_cuts_device(cand, real_blocks, cp)

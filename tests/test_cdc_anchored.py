@@ -291,3 +291,54 @@ def test_cdc_tpu_v1_deprecation_warning():
         warnings.simplefilter("always")
         get_fragmenter("cdc-tpu")
     assert any(issubclass(x.category, DeprecationWarning) for x in w)
+
+
+# ---------------------------------------------------------------------------
+# Pallas repack kernel (ops.repack) vs the XLA fallback
+# ---------------------------------------------------------------------------
+
+def test_repack_pallas_matches_xla_fallback():
+    """The DMA-gather + in-register-rotate kernel must agree with
+    vmap(dynamic_slice)+funnel bit-for-bit, including the clamp branch
+    (a segment start within one DMA window of the buffer end) and every
+    byte phase. Runs through the Pallas interpreter on CPU; on real TPU
+    the same kernel is exercised end-to-end by bench.py's hashlib
+    asserts."""
+    import jax
+    import numpy as np
+
+    from dfs_tpu.ops.repack import (_window_rows, repack_lanes,
+                                    repack_lanes_xla)
+
+    lane_words = 1024                      # 8 rows per lane
+    m_total = 8 * 1024                     # multiple of the 1024-word tiling
+    assert m_total // 128 >= _window_rows(lane_words)
+    rng = np.random.default_rng(7)
+    words = jax.device_put(
+        rng.integers(0, 2**32, size=m_total, dtype=np.uint32))
+
+    hi = m_total - lane_words - 1          # caller invariant bound
+    offs = [0, 1, 5, 1023, 1024, 1025, hi, hi - 1, hi - 1023]
+    offs += [int(x) for x in rng.integers(0, hi + 1, size=7)]
+    w_off = np.asarray(offs, dtype=np.int32)
+    sh8 = np.asarray([(i % 4) * 8 for i in range(len(offs))], np.uint32)
+
+    want = np.asarray(repack_lanes_xla(words, jax.device_put(w_off),
+                                       jax.device_put(sh8), lane_words))
+    got = np.asarray(repack_lanes(words, jax.device_put(w_off),
+                                  jax.device_put(sh8), lane_words,
+                                  interpret=True))
+    assert np.array_equal(got, want)
+
+
+def test_region_buffer_size_is_dma_tiled():
+    """The staging buffer must land on the repack kernel's 4096-byte DMA
+    tiling, and region_dispatch's floored m_words recovery must keep the
+    chunk output identical to the oracle (covered by the oracle-parity
+    tests above running through region_chunks)."""
+    from dfs_tpu.ops.cdc_anchored import (AnchoredCdcParams,
+                                          region_buffer_size)
+
+    p = AnchoredCdcParams()
+    for n in (1, 4096, 64 * 2**20, 64 * 2**20 - 5):
+        assert region_buffer_size(n, p) % 4096 == 0

@@ -85,11 +85,23 @@ def main() -> int:
     tpu = AnchoredTpuFragmenter()
     run(tpu, warm)                               # compile + warm transfers
     tpu_dt, n = run(tpu, blocks)
-    gibps = total / tpu_dt / 2**30
-    log(f"tpu anchored (streamed): {gibps:.3f} GiB/s "
+    log(f"tpu anchored (streamed): {total / tpu_dt / 2**30:.3f} GiB/s "
         f"({tpu_dt:.1f}s, {n} chunks)")
-    vs = (cpu_dt / tpu_dt) if cpu_dt else 0.0
-    print(json.dumps({"metric": "e2e_stream_chunk_hash_1GiB",
+
+    # the recorded metric is the PRODUCTION path: `auto` probes staging
+    # bandwidth once and picks device vs native-CPU engine (what a node
+    # started with the default fragmenter actually ingests at on this
+    # link, fragmenter/base.py:tpu_available) — the explicit device and
+    # CPU numbers above are the diagnostic split
+    from dfs_tpu.fragmenter.base import get_fragmenter
+    auto = get_fragmenter("auto")
+    log(f"auto picked: {auto.name}")
+    run(auto, warm)
+    auto_dt, n = run(auto, blocks)
+    gibps = total / auto_dt / 2**30
+    log(f"auto (streamed): {gibps:.3f} GiB/s ({auto_dt:.1f}s, {n} chunks)")
+    vs = (cpu_dt / auto_dt) if cpu_dt else 1.0
+    print(json.dumps({"metric": "e2e_stream_chunk_hash_1GiB_auto",
                       "value": round(gibps, 3), "unit": "GiB/s",
                       "vs_baseline": round(vs, 3)}))
     return 0

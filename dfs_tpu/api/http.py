@@ -304,6 +304,14 @@ async def _serve_one(node: "StorageNodeServer",
                              "chunks": manifest.total_chunks, **stats})
 
     if method == "POST" and path == "/upload":
+        ec_k = 0
+        if query.get("ec"):
+            if not query["ec"].isdigit() or int(query["ec"]) < 1:
+                return plain(400, "Bad ec parameter")
+            ec_k = int(query["ec"])
+            if chunked:
+                return plain(400, "ec requires a whole-body upload "
+                                  "(parity stripes span chunk groups)")
         if chunked:
             # streaming ingest: the chunked-transfer body feeds the
             # fragmenter's bounded-memory pipeline as it arrives — the
@@ -326,9 +334,11 @@ async def _serve_one(node: "StorageNodeServer",
             return plain(413, "Payload Too Large")
         data = await reader.readexactly(content_length)
         try:
-            manifest, stats = await node.upload(data, query.get("name", ""))
+            manifest, stats = await node.upload(data, query.get("name", ""),
+                                                ec_k=ec_k)
         except UploadError as e:
-            return plain(500, str(e))  # "Replication failed", :176
+            # "Replication failed" -> 500 (:176); ec validation -> 400
+            return plain(getattr(e, "status", 500), str(e))
         return as_json(201, {"fileId": manifest.file_id,
                              "name": manifest.name,
                              "size": manifest.size,

@@ -52,8 +52,11 @@ class NodeClient:
                            size=i.get("size", 0), chunks=i.get("chunks", 0))
                 for i in items]
 
-    def upload(self, data: bytes, name: str) -> dict:
-        q = urllib.parse.urlencode({"name": name})
+    def upload(self, data: bytes, name: str, ec: int = 0) -> dict:
+        params = {"name": name}
+        if ec:
+            params["ec"] = str(ec)
+        q = urllib.parse.urlencode(params)
         return json.loads(self._request("POST", f"/upload?{q}", body=data))
 
     def upload_stream(self, blocks, name: str) -> dict:

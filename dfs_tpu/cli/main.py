@@ -129,17 +129,25 @@ def cmd_list(args) -> int:
 def cmd_upload(args) -> int:
     path = Path(args.file)
     data = path.read_bytes()
+    ec = getattr(args, "ec", 0)
     if getattr(args, "resume", False):
+        if ec:
+            print("--ec and --resume are mutually exclusive "
+                  "(parity stripes need the whole-body upload path)",
+                  file=sys.stderr)
+            return 2
         # chunk locally, probe, send only missing payloads (SURVEY §5.4)
         info = _client(args).upload_resume(data, name=path.name)
         print(f"Uploaded (resume): fileId={info['fileId']} "
               f"chunks={info['chunks']} "
               f"clientSent={info['clientBytesSent']}B of {len(data)}B")
         return 0
-    info = _client(args).upload(data, name=path.name)
+    info = _client(args).upload(data, name=path.name, ec=ec)
+    extra = (f" ecParity={info['ecParityBytes']}B"
+             if "ecParityBytes" in info else "")
     print(f"Uploaded: fileId={info['fileId']} chunks={info['chunks']} "
           f"transferred={info.get('transferredBytes', '?')}B "
-          f"dedupSkipped={info.get('dedupSkippedBytes', '?')}B")
+          f"dedupSkipped={info.get('dedupSkippedBytes', '?')}B{extra}")
     return 0
 
 
@@ -283,6 +291,10 @@ def build_parser() -> argparse.ArgumentParser:
     up.add_argument("file")
     up.add_argument("--resume", action="store_true",
                     help="probe the cluster and send only missing chunks")
+    up.add_argument("--ec", type=int, default=0, metavar="K",
+                    help="erasure-code with K data shards + P/Q parity "
+                         "per stripe (needs K+2 cluster nodes; any two "
+                         "lost shards per stripe are recoverable)")
     up.set_defaults(fn=cmd_upload)
     down = sub.add_parser("download")
     down.add_argument("file_id")

@@ -177,12 +177,16 @@ class InternalClient:
         _, body = await self.call(peer, {"op": "get_chunk", "digest": digest})
         return body
 
-    async def get_chunks(self, peer: PeerAddr,
-                         digests: list[str]) -> list[tuple[str, bytes]]:
+    async def get_chunks(self, peer: PeerAddr, digests: list[str],
+                         retries: int | None = None
+                         ) -> list[tuple[str, bytes]]:
         """Batched fetch: returns (digest, bytes) for every requested
-        chunk the peer holds (missing ones are absent — no error)."""
+        chunk the peer holds (missing ones are absent — no error).
+        ``retries`` as in :meth:`call` (callers pass 1 for known-dead
+        peers)."""
         resp, body = await self.call(
-            peer, {"op": "get_chunks", "digests": digests})
+            peer, {"op": "get_chunks", "digests": digests},
+            retries=retries)
         return unpack_chunks(resp.get("chunks", []), body)
 
     async def get_manifest(self, peer: PeerAddr, file_id: str

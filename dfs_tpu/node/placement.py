@@ -21,3 +21,21 @@ def replica_set(digest: str, node_ids: list[int], rf: int) -> list[int]:
     rf = min(rf, len(node_ids))
     start = int(digest[:16], 16) % len(node_ids)
     return [node_ids[(start + j) % len(node_ids)] for j in range(rf)]
+
+
+def ec_shard_node(file_id: str, stripe: int, shard: int,
+                  node_ids: list[int]) -> int:
+    """Holder of shard ``shard`` (0..k-1 data, k = P, k+1 = Q) of erasure
+    stripe ``stripe``. Digest-derived placement would let two shards of a
+    stripe collide on one node — then a single node loss can exceed the
+    P+Q budget, making EC WORSE than replication. Instead the stripe's
+    base node is derived from (file_id, stripe) and shards fan out
+    consecutively, so all k+2 land on distinct nodes whenever the cluster
+    is big enough (upload enforces k+2 <= N). Computable from the
+    manifest alone — any node can locate any shard for repair, matching
+    replica_set's property for replicated chunks. Different stripes get
+    different bases, spreading load across the cluster."""
+    if not node_ids:
+        raise ValueError("empty cluster")
+    base = (int(file_id[:16], 16) + stripe * 2654435761) % len(node_ids)
+    return node_ids[(base + shard) % len(node_ids)]

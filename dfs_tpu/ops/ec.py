@@ -1,0 +1,241 @@
+"""Erasure coding: RAID-6-style P+Q parity over GF(256), TPU-native.
+
+The reference's only redundancy is cyclic x2 replication — 100% storage
+overhead, tolerates ONE lost node on the read path (StorageNode.java:
+143-145, 425-441; README.md:65-81). This codec gives the framework an
+erasure-coded mode: a stripe of ``k`` data shards gains two parity
+shards
+
+    P = d_0 ^ d_1 ^ ... ^ d_{k-1}
+    Q = g^{k-1}·d_0 ^ g^{k-2}·d_1 ^ ... ^ g^0·d_{k-1}        (GF(256))
+
+so ANY two lost shards are recoverable — strictly better durability than
+replication at (k+2)/k storage instead of 2x.
+
+TPU angle: the encode is deliberately table-free. GF(256) doubling is
+
+    xtime(x) = (x << 1) ^ (0x1D if x & 0x80 else 0)  (mod x^8+x^4+x^3+x^2+1)
+
+and Q falls out of a Horner scan ``q = xtime(q) ^ d_i`` — pure bitwise
+VPU ops over u32-packed lanes, memory-bound on HBM like the rest of the
+chunk pipeline (no gathers, no log/exp tables on the hot path). The
+NumPy forms are the byte-identical oracle and the CPU fallback.
+
+Decode (cold path — only runs degraded) solves the 1- and 2-erasure
+cases with the standard RAID-6 algebra on the host; the g^i/inverse
+tables live here and are only touched on decode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+_POLY = 0x1D  # x^8 + x^4 + x^3 + x^2 + 1 — the RAID-6 field: 2 IS a
+# generator here (it is NOT in the AES field 0x11B, whose element 2 has
+# order 51 — log/exp tables on g=2 would be silently wrong there)
+
+
+# ---------------------------------------------------------------------------
+# GF(256) tables (decode-time only)
+# ---------------------------------------------------------------------------
+
+@functools.cache
+def _tables() -> tuple[np.ndarray, np.ndarray]:
+    """(log, exp) tables for generator 2: exp[i] = 2^i, log[exp[i]] = i."""
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= _POLY | 0x100
+    exp[255:510] = exp[:255]
+    return log, exp
+
+
+def gf_mul(a: int, b: int) -> int:
+    """Scalar GF(256) multiply (decode coefficients only)."""
+    if a == 0 or b == 0:
+        return 0
+    log, exp = _tables()
+    return int(exp[int(log[a]) + int(log[b])])
+
+
+def gf_inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("GF(256) inverse of 0")
+    log, exp = _tables()
+    return int(exp[255 - int(log[a])])
+
+
+def gf_pow(a: int, n: int) -> int:
+    r = 1
+    for _ in range(n):
+        r = gf_mul(r, a)
+    return r
+
+
+def _gf_mul_bytes(c: int, x: np.ndarray) -> np.ndarray:
+    """Constant × byte-array multiply via log/exp (decode path)."""
+    if c == 0:
+        return np.zeros_like(x)
+    log, exp = _tables()
+    out = np.zeros_like(x)
+    nz = x != 0
+    out[nz] = exp[int(log[c]) + log[x[nz].astype(np.int32)]]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# encode: P/Q over u32-packed shards (NumPy oracle + device form)
+# ---------------------------------------------------------------------------
+
+def _xtime_np(x: np.ndarray) -> np.ndarray:
+    """GF doubling on u32 words holding 4 independent byte lanes."""
+    x = x.astype(np.uint32)
+    hi = x & np.uint32(0x80808080)
+    lo = (x ^ hi) << np.uint32(1)
+    return lo ^ ((hi >> np.uint32(7)) * np.uint32(_POLY))
+
+
+def encode_pq_np(shards: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """shards [k, L] u8 (equal padded length, L % 4 == 0) ->
+    (p [L] u8, q [L] u8). Horner: q = xtime(q) ^ d_i in shard order."""
+    k, ln = shards.shape
+    if ln % 4:
+        raise ValueError("shard length must be a multiple of 4")
+    w = shards.view(np.uint32)                     # [k, L/4]
+    p = np.zeros_like(w[0])
+    q = np.zeros_like(w[0])
+    for i in range(k):
+        p ^= w[i]
+        q = _xtime_np(q) ^ w[i]
+    return p.view(np.uint8), q.view(np.uint8)
+
+
+@functools.cache
+def _make_encode_fn(k: int):
+    """Compiled device encode for a k-shard stripe: words [k, n] u32 ->
+    (p [n] u32, q [n] u32). Pure bitwise VPU ops — no tables."""
+    import jax
+    import jax.numpy as jnp
+
+    def xtime(x):
+        hi = x & jnp.uint32(0x80808080)
+        lo = (x ^ hi) << jnp.uint32(1)
+        return lo ^ ((hi >> jnp.uint32(7)) * jnp.uint32(_POLY))
+
+    @jax.jit
+    def run(words):
+        p = jnp.zeros_like(words[0])
+        q = jnp.zeros_like(words[0])
+        for i in range(k):                 # k is static and small
+            p = p ^ words[i]
+            q = xtime(q) ^ words[i]
+        return p, q
+
+    return run
+
+
+def encode_pq(shards: np.ndarray, device: bool | None = None
+              ) -> tuple[np.ndarray, np.ndarray]:
+    """P/Q parity for a stripe. ``device=None`` picks the accelerator
+    when one is the default backend (the encode is memory-bound xor/shift
+    work the VPU does at HBM speed); False forces the NumPy oracle."""
+    if device is None:
+        import jax
+        device = jax.default_backend() != "cpu"
+    if not device:
+        return encode_pq_np(shards)
+    import jax
+
+    k, ln = shards.shape
+    if ln % 4:
+        raise ValueError("shard length must be a multiple of 4")
+    p, q = _make_encode_fn(k)(jax.device_put(shards.view(np.uint32)))
+    return (np.asarray(p).view(np.uint8), np.asarray(q).view(np.uint8))
+
+
+# ---------------------------------------------------------------------------
+# decode: recover up to two missing shards (host path, degraded only)
+# ---------------------------------------------------------------------------
+
+def _q_coeff(i: int, k: int) -> int:
+    """Q's coefficient for data shard i: g^(k-1-i) (Horner order)."""
+    return gf_pow(2, k - 1 - i)
+
+
+def recover_stripe(data: list[np.ndarray | None],
+                   p: np.ndarray | None, q: np.ndarray | None
+                   ) -> list[np.ndarray]:
+    """Recover missing data shards. ``data`` is the k-slot stripe with
+    ``None`` for lost shards (present arrays all the same padded length);
+    ``p``/``q`` are the parity shards or ``None`` if lost too. Returns
+    the complete data list. Raises ValueError when more than two shards
+    (counting lost parity) are missing — beyond P+Q's budget."""
+    k = len(data)
+    missing = [i for i, d in enumerate(data) if d is None]
+    lost = len(missing) + (p is None) + (q is None)
+    if lost > 2:
+        raise ValueError(f"{lost} shards lost, P+Q recovers at most 2")
+    if not missing:
+        return [d for d in data]  # type: ignore[misc]
+    present = next(d for d in data if d is not None) if k > len(missing) \
+        else (p if p is not None else q)
+    if present is None:
+        raise ValueError("nothing to recover from")
+    ln = present.shape[0]
+
+    def xor_known(skip: set[int]) -> np.ndarray:
+        acc = np.zeros(ln, dtype=np.uint8)
+        w = acc.view(np.uint32)
+        for i, d in enumerate(data):
+            if i not in skip and d is not None:
+                w ^= d.view(np.uint32)
+        return acc
+
+    if len(missing) == 1:
+        i = missing[0]
+        if p is not None:
+            # d_i = P ^ xor(other data)
+            rec = xor_known({i})
+            rec.view(np.uint32)[:] ^= p.view(np.uint32)
+            out = list(data)
+            out[i] = rec
+            return out  # type: ignore[return-value]
+        # P lost too -> solve from Q: g^(k-1-i)·d_i = Q ^ sum g^..·d_j
+        acc = np.zeros(ln, dtype=np.uint8)
+        for j, d in enumerate(data):
+            if j != i and d is not None:
+                acc ^= _gf_mul_bytes(_q_coeff(j, k), d)
+        acc ^= q
+        out = list(data)
+        out[i] = _gf_mul_bytes(gf_inv(_q_coeff(i, k)), acc)
+        return out  # type: ignore[return-value]
+
+    # two data shards missing: need both P and Q
+    if p is None or q is None:
+        raise ValueError("two data shards and a parity shard lost")
+    a, b = missing
+    ca, cb = _q_coeff(a, k), _q_coeff(b, k)
+    # P ^ known = d_a ^ d_b           = s
+    # Q ^ known = ca·d_a ^ cb·d_b    = t
+    s = xor_known({a, b})
+    s.view(np.uint32)[:] ^= p.view(np.uint32)
+    t = np.zeros(ln, dtype=np.uint8)
+    for j, d in enumerate(data):
+        if j not in (a, b) and d is not None:
+            t ^= _gf_mul_bytes(_q_coeff(j, k), d)
+    t ^= q
+    # d_a = (cb·s ^ t) / (ca ^ cb)
+    denom_inv = gf_inv(ca ^ cb)
+    da = _gf_mul_bytes(denom_inv, _gf_mul_bytes(cb, s) ^ t)
+    db = s ^ da
+    out = list(data)
+    out[a] = da
+    out[b] = db
+    return out  # type: ignore[return-value]

@@ -238,7 +238,7 @@ class NodeStore:
         one. Delete-triggered GC keeps age 0: explicit user intent."""
         live: set[str] = set()
         for m in self.manifests.list():
-            live.update(m.digests())
+            live.update(m.all_digests())   # incl. erasure parity chunks
         cutoff = time.time() - min_age_s
         dead = []
         for d in self.chunks.digests():

@@ -1,0 +1,183 @@
+"""Erasure-coded storage mode, end to end on an in-process cluster.
+
+The reference tolerates ONE dead node on reads via x2 replication
+(StorageNode.java:425-441, README.md:81; 100% storage overhead). The EC
+mode stores single copies plus P+Q parity per stripe of k chunks
+(ops.ec), placed on k+2 distinct nodes (node.placement.ec_shard_node):
+ANY TWO lost shards per stripe are recoverable at (k+2)/k overhead —
+strictly beyond the reference's capability surface.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dfs_tpu.meta.manifest import Manifest, ec_stripe_groups
+from dfs_tpu.node.placement import ec_shard_node
+from dfs_tpu.node.runtime import (DownloadError, UploadError,
+                                  ec_placement_map, ec_shard_items)
+
+from tests.test_node_cluster import make_cluster_cfg, start_nodes, stop_nodes
+
+
+def test_ec_upload_places_single_copies_on_distinct_nodes(tmp_path, rng):
+    data = rng.integers(0, 256, size=40_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(5)
+        ids = cluster.sorted_ids()
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            manifest, stats = await nodes[1].upload(data, "ec.bin", ec_k=3)
+            assert manifest.ec is not None and manifest.ec.k == 3
+            assert stats["ecParityBytes"] > 0
+            # stripe shards land on k+2 distinct nodes
+            groups = ec_stripe_groups(manifest.chunks, 3)
+            for s, grp in enumerate(groups):
+                holders = [ec_shard_node(manifest.file_id, s, j, ids)
+                           for j in range(len(grp) + 2)]
+                assert len(set(holders)) == len(grp) + 2
+            # every shard exists exactly where the placement map says
+            pl = ec_placement_map(manifest, ids)
+            for d, ln in ec_shard_items(manifest):
+                holders = [n for n in ids if nodes[n].store.chunks.has(d)]
+                assert holders, d
+                assert set(pl[d]) & set(holders), (d, pl[d], holders)
+            # storage overhead ~ (k+2)/k, nowhere near replication's 2x
+            total = sum(ln for _, ln in ec_shard_items(manifest))
+            assert total < 1.8 * len(data)
+            # plain read path works untouched
+            _, got = await nodes[4].download(manifest.file_id)
+            assert got == data
+            return manifest
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_ec_download_survives_two_dead_nodes(tmp_path, rng):
+    """k=3 on a 5-node cluster: kill TWO nodes, download byte-identical
+    from a survivor — the reference dies at one."""
+    data = rng.integers(0, 256, size=60_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(5)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            manifest, _ = await nodes[1].upload(data, "two-down.bin",
+                                                ec_k=3)
+            # kill two nodes that are NOT the reader
+            await nodes[2].stop()
+            await nodes[3].stop()
+            del nodes[2], nodes[3]
+            _, got = await nodes[5].download(manifest.file_id)
+            assert got == data
+            snap = nodes[5].counters.snapshot()
+            # shards on the dead nodes had no surviving copy -> decode ran
+            assert snap.get("ec_decodes", 0) > 0
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_ec_repair_regenerates_destroyed_single_copy(tmp_path, rng):
+    """Wipe every chunk one node holds (disk loss). The shard bytes then
+    exist NOWHERE — only parity decode can bring them back; a replicated
+    chunk in that state would be gone. The holder's own repair pass must
+    regenerate them locally."""
+    data = rng.integers(0, 256, size=50_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(5)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            manifest, _ = await nodes[1].upload(data, "wipe.bin", ec_k=3)
+            victim = nodes[2]
+            lost = [d for d in victim.store.chunks.digests()]
+            for d in lost:
+                victim.store.chunks.delete(d)
+            if not lost:
+                pytest.skip("placement gave node 2 no shards (tiny file)")
+            assert not any(victim.store.chunks.has(d) for d in lost)
+            repaired = await victim.repair_once()
+            assert repaired >= len(
+                set(lost) & {d for d, _ in ec_shard_items(manifest)})
+            for d in lost:
+                assert victim.store.chunks.has(d), d
+            assert victim.counters.snapshot().get("ec_decodes", 0) > 0
+            # and the file still reads byte-identical everywhere
+            _, got = await nodes[4].download(manifest.file_id)
+            assert got == data
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_ec_upload_rejects_small_cluster(tmp_path, rng):
+    data = rng.integers(0, 256, size=10_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            with pytest.raises(UploadError) as ei:
+                await nodes[1].upload(data, "toobig.bin", ec_k=3)
+            assert ei.value.status == 400
+            # k=1 (mirror-with-parity) still fits 3 nodes
+            manifest, _ = await nodes[1].upload(data, "k1.bin", ec_k=1)
+            assert manifest.ec is not None
+            _, got = await nodes[2].download(manifest.file_id)
+            assert got == data
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_ec_manifest_announce_roundtrip(tmp_path, rng):
+    """The EC layout survives the announce path (JSON round-trip) so any
+    node can locate and decode shards from its adopted manifest."""
+    data = rng.integers(0, 256, size=30_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(5)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            manifest, _ = await nodes[1].upload(data, "ann.bin", ec_k=3)
+            m5 = nodes[5].store.manifests.load(manifest.file_id)
+            assert m5 is not None and m5.ec is not None
+            assert m5.ec == manifest.ec
+            assert Manifest.from_json(m5.to_json()).ec == manifest.ec
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_ec_delete_reclaims_parity(tmp_path, rng):
+    data = rng.integers(0, 256, size=30_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(5)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            manifest, _ = await nodes[1].upload(data, "gone.bin", ec_k=3)
+            parity = [st.p for st in manifest.ec.stripes] \
+                + [st.q for st in manifest.ec.stripes]
+            assert any(nodes[n].store.chunks.has(d)
+                       for d in parity for n in nodes)
+            assert await nodes[3].delete(manifest.file_id)
+            await asyncio.sleep(0)
+            for n in nodes.values():
+                await n.repair_once()      # triggers tombstone + gc sweep
+            for d in parity:
+                assert not any(nodes[n].store.chunks.has(d)
+                               for n in nodes), d
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())

@@ -306,7 +306,9 @@ async def _serve_one(node: "StorageNodeServer",
     if method == "POST" and path == "/upload":
         ec_k = 0
         if query.get("ec"):
-            if not query["ec"].isdigit() or int(query["ec"]) < 1:
+            # isdecimal, not isdigit: the latter passes non-ASCII digits
+            # (e.g. '²') that int() then rejects — a 500 instead of 400
+            if not query["ec"].isdecimal() or int(query["ec"]) < 1:
                 return plain(400, "Bad ec parameter")
             ec_k = int(query["ec"])
             if chunked:

@@ -301,11 +301,21 @@ class StorageNodeServer:
                     f"ec={ec_k} needs {ec_k + 2} nodes, cluster has "
                     f"{len(ids)} (shards of a stripe must land on "
                     "distinct nodes)", status=400)
+            if ec_k > 255:
+                # the Q coefficients live in GF(256)*'s order-255 group:
+                # beyond k=255 they repeat and some double erasures
+                # become uncorrectable — the any-2-lost guarantee fails
+                raise UploadError("ec must be <= 255", status=400)
             with span("upload.ec_encode", self.latency):
                 manifest, parity = await asyncio.to_thread(
                     self._ec_extend, manifest, data, ec_k)
-            batch.extend((d, b) for d, b in parity if d not in seen)
-            seen.update(d for d, _ in parity)
+            for d, b in parity:
+                # per-item seen check: P and Q can share a digest
+                # (k=1 makes Q == P), and a lazy bulk-extend would
+                # place it twice
+                if d not in seen:
+                    seen.add(d)
+                    batch.append((d, b))
             stats["ecParityBytes"] = sum(len(b) for _, b in parity)
             placement = ec_placement_map(manifest, ids)
             rf = 1   # the parity IS the redundancy (any 2 shards may die)
@@ -1049,15 +1059,18 @@ class StorageNodeServer:
             for s, (st, grp) in enumerate(zip(ec.stripes, groups))
             if wanted.intersection([c.digest for c in grp]
                                    + [st.p, st.q])]
+        # `wanted` digests were JUST proven unreachable by the caller's
+        # gather — re-fetching them would repeat the dead-holder probes
+        # and the cluster-wide sweep per degraded read
         fetch: dict[str, ChunkRef] = {}
         for s, st, grp in affected:
             for c in grp:
-                if c.digest not in out:
+                if c.digest not in out and c.digest not in wanted:
                     fetch.setdefault(c.digest, ChunkRef(
                         index=0, offset=0, length=c.length,
                         digest=c.digest))
             for d in (st.p, st.q):
-                if d not in out:
+                if d not in out and d not in wanted:
                     fetch.setdefault(d, ChunkRef(
                         index=0, offset=0, length=st.shard_len, digest=d))
         have = dict(out)

@@ -426,7 +426,8 @@ def make_anchored_segment_fn(params: AnchoredCdcParams, m_words: int,
     from dfs_tpu.ops.sha256_jax import _H0
     from dfs_tpu.ops.sha256_strip import (_compress_dispatch,
                                           cut_state_rows,
-                                          pad_finalize_device, strip_states,
+                                          pad_finalize_device,
+                                          strip_chunk_states,
                                           strip_states_xla)
 
     cp = params.chunk
@@ -466,11 +467,17 @@ def make_anchored_segment_fn(params: AnchoredCdcParams, m_words: int,
         packed = repack_lanes(words, w_off, sh8, lane_words)
 
         words_t = bswap_transpose(packed)              # [bps*16, s_pad] BE
-        cand = gear_candidates_device(words_t, cp)
-        cutflag, since = select_cuts_device(cand, real_blocks, cp)
-        cf32 = cutflag.astype(jnp.int32)
-        states = (strip_states if use_pallas else strip_states_xla)(
-            words_t, cf32)
+        if use_pallas:
+            # fused candidates+selection+SHA: one pass over the resident
+            # words instead of three (ops.sha256_strip.strip_chunk_states)
+            cf32, since, states = strip_chunk_states(
+                words_t, real_blocks, cp.seed, cp.mask, cp.min_blocks,
+                cp.max_blocks)
+        else:
+            cand = gear_candidates_device(words_t, cp)
+            cutflag, since = select_cuts_device(cand, real_blocks, cp)
+            cf32 = cutflag.astype(jnp.int32)
+            states = strip_states_xla(words_t, cf32)
         # states relayout here (not in compact) so the 50 MB transpose
         # stays in the module XLA already fuses the scan into
         return cf32, since, cut_state_rows(states, s_pad)

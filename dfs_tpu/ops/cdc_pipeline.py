@@ -56,7 +56,8 @@ def make_segment_fn(params: AlignedCdcParams, s_real: int, s_pad: int):
     import jax.numpy as jnp
 
     from dfs_tpu.ops.sha256_strip import (gather_cut_states,
-                                          pad_finalize_device, strip_states,
+                                          pad_finalize_device,
+                                          strip_chunk_states,
                                           strip_states_xla)
 
     from dfs_tpu.ops.layout import bswap_transpose
@@ -90,11 +91,17 @@ def make_segment_fn(params: AlignedCdcParams, s_real: int, s_pad: int):
         if s_pad != s_real:
             words_t = jnp.pad(words_t, ((0, 0), (0, s_pad - s_real)))
 
-        cand = gear_candidates_device(words_t, params)
-        cutflag, _ = select_cuts_device(cand, real_blocks, params)
-        cf32 = cutflag.astype(jnp.int32)
-        states = (strip_states if use_pallas else strip_states_xla)(
-            words_t, cf32)
+        if use_pallas:
+            # fused candidates+selection+SHA (ops.sha256_strip) — one
+            # pass over the resident words instead of three
+            cf32, _, states = strip_chunk_states(
+                words_t, real_blocks, params.seed, params.mask,
+                params.min_blocks, params.max_blocks)
+        else:
+            cand = gear_candidates_device(words_t, params)
+            cutflag, _ = select_cuts_device(cand, real_blocks, params)
+            cf32 = cutflag.astype(jnp.int32)
+            states = strip_states_xla(words_t, cf32)
         return cf32, states
 
     @jax.jit

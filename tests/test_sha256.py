@@ -50,3 +50,52 @@ def test_pad_messages_rounding():
 def test_exact_block_boundaries_single(n, rng):
     m = rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
     assert sha256_batch_hex([m]) == [hashlib.sha256(m).hexdigest()]
+
+
+@pytest.mark.slow
+def test_fused_strip_chunk_states_matches_three_stage():
+    """strip_chunk_states (fused Pallas candidates+selection+SHA) must be
+    bit-identical to gear_candidates_device + select_cuts_device +
+    strip_states_xla. The Pallas interpreter grinds on the unrolled
+    compression (~minutes even at these shapes), so this runs in the
+    opt-in slow tier; the default-tier evidence is bench.py's hashlib
+    digest asserts through the full fused chain on real TPU."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dfs_tpu.ops.cdc_v2 import (AlignedCdcParams,
+                                    gear_candidates_device,
+                                    select_cuts_device)
+    from dfs_tpu.ops.sha256_strip import (strip_chunk_states,
+                                          strip_states_xla)
+
+    cp = AlignedCdcParams(min_blocks=2, avg_blocks=4, max_blocks=8,
+                          strip_blocks=16)          # 1 KiB lanes
+    s = 128
+    rng = np.random.default_rng(11)
+    words_t = jax.device_put(rng.integers(
+        0, 2**32, size=(cp.strip_blocks * 16, s), dtype=np.uint32))
+    # mixed lane occupancy: full, partial, tail, empty
+    rb = np.zeros((s,), np.int32)
+    rb[:100] = cp.strip_blocks
+    rb[100:110] = rng.integers(1, cp.strip_blocks, size=10)
+    rb[110] = 1   # single-block lane
+    real_blocks = jax.device_put(rb)
+
+    cand = gear_candidates_device(words_t, cp)
+    cutflag, since0 = select_cuts_device(cand, real_blocks, cp)
+    cf0 = np.asarray(cutflag.astype(jnp.int32))
+    states0 = np.asarray(strip_states_xla(words_t, jnp.asarray(cf0)))
+
+    cf1, since1, states1 = strip_chunk_states(
+        words_t, real_blocks, cp.seed, cp.mask, cp.min_blocks,
+        cp.max_blocks, interpret=True)
+    assert np.array_equal(np.asarray(cf1), cf0)
+    assert np.array_equal(np.asarray(since1), np.asarray(since0))
+    # states only meaningful for real lanes (padding lanes carry garbage
+    # in both paths but are never gathered)
+    live = rb > 0
+    s0 = states0.reshape(cp.strip_blocks, 8, s)
+    s1 = np.asarray(states1).reshape(cp.strip_blocks, 8, s)
+    assert np.array_equal(s1[:, :, live], s0[:, :, live])

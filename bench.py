@@ -20,10 +20,11 @@ multi-region stream has no host sync until results are pulled.
 Two numbers are reported (the round-1 conflation of compile+staging+compute
 is gone):
 - stdout JSON (the driver's record): **resident sustained** GiB/s — region
-  buffer in HBM, multi-pass slope (1 vs N chained dispatches, one sync),
-  i.e. the kernel capability that an overlapped ingest path (double-
-  buffered device_put, fragmenter/cdc_anchored.py) converges to on real
-  PCIe/DMA links.
+  buffer in HBM, difference-of-mins slope (minima of repeated k=3 and
+  k=12 chain timings across ~30 s of the shared chip's contention
+  bursts), i.e. the kernel capability that an overlapped ingest path
+  (double-buffered device_put, fragmenter/cdc_anchored.py) converges to
+  on real PCIe/DMA links.
 - stderr: warm end-to-end (staging + compute, compile excluded) — the
   harness's SHARED device tunnel swings from ~1.5 GB/s to ~10 MB/s hour
   to hour (measured round 3), so this number tracks link contention, not

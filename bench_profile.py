@@ -1,10 +1,17 @@
 """Stage-level profile of the anchored device chain (diagnostic, not a
-driver benchmark). Slope-times each stage of region_dispatch separately:
-anchor -> select -> descriptors -> scan_half (repack+candidates+select+
-strip SHA) -> compact_half (compaction+finalize+tails). All numbers are
-min-of-N slopes (1 vs K dispatches) to exclude sync + tunnel jitter.
+driver benchmark). Times each dispatch of region_dispatch — anchor ->
+select -> descriptors -> scan_half (Pallas repack + fused
+candidates/selection/SHA) -> compact_half — plus the fused kernel and
+repack in isolation.
 
-Usage: python bench_profile.py [region_mib] [passes] [reps]
+Estimator: difference-of-mins (bench.py's discipline — round 3 found
+min-of-per-rep-slopes biased LOW under the shared chip's bursty
+contention), with all stages sampled INTERLEAVED per round so a burst
+inflates every stage equally rather than whichever ran during it.
+Sub-stage numbers still jitter with chip load; the "full chain" row is
+the trustworthy one and stages are indicative.
+
+Usage: python bench_profile.py [region_mib] [reps]
 """
 
 from __future__ import annotations
@@ -15,43 +22,24 @@ import time
 import numpy as np
 
 
-def slope(fn, passes: int, reps: int) -> float:
-    """Per-dispatch time via a (k_lo, k_hi) slope with k_lo > 1: the
-    tunnel's block_until_ready round-trip is ~100-150 ms with +-40 ms
-    jitter, so a (1, N) slope carries jitter/N noise — both ends must
-    amortize dispatch count, and the difference divides the jitter."""
-    import jax
-
-    k_lo, k_hi = 4, max(passes, 12)
-    best = float("inf")
-    for _ in range(reps):
-        times = []
-        for k in (k_lo, k_hi):
-            jax.block_until_ready(fn())   # drain queue before timing
-            t0 = time.perf_counter()
-            out = None
-            for _ in range(k):
-                out = fn()
-            jax.block_until_ready(out)
-            times.append(time.perf_counter() - t0)
-        best = min(best, (times[1] - times[0]) / (k_hi - k_lo))
-    return best
-
-
 def main() -> int:
     region = (int(sys.argv[1]) if len(sys.argv) > 1 else 64) * 2**20
-    passes = int(sys.argv[2]) if len(sys.argv) > 2 else 8
-    reps = int(sys.argv[3]) if len(sys.argv) > 3 else 5
+    reps = int(sys.argv[2]) if len(sys.argv) > 2 else 10
 
     import jax
+    import jax.numpy as jnp
 
     from dfs_tpu.ops import cdc_anchored as A
     from dfs_tpu.ops.cdc_anchored import (AnchoredCdcParams, region_buffer,
                                           region_dispatch)
+    from dfs_tpu.ops.layout import bswap_transpose
+    from dfs_tpu.ops.repack import repack_lanes
+    from dfs_tpu.ops.sha256_strip import strip_chunk_states
 
     params = AnchoredCdcParams()
+    cp = params.chunk
     rng = np.random.default_rng(0)
-    data = rng.integers(0, 256, size=region, dtype=np.uint8).astype(np.uint8)
+    data = rng.integers(0, 256, size=region, dtype=np.uint8)
     words = jax.device_put(region_buffer(data, np.zeros((8,), np.uint8),
                                          params))
 
@@ -76,148 +64,75 @@ def main() -> int:
     d = desc(bounds, z)
     starts, seg_lens, w_off, sh8, real_blocks, tail_len, consumed = d
     jax.block_until_ready(d)
+    scan_half, compact_half = seg.halves
+    sh_out = jax.block_until_ready(
+        scan_half(words, w_off, sh8, real_blocks))
 
-    stages = {
-        "anchor": lambda: anchor(words),
-        "select": lambda: select(tiles, z, n, fin),
-        "descriptors": lambda: desc(bounds, z),
-        "segment(B)": lambda: seg(words, w_off, sh8, real_blocks,
-                                  tail_len, starts, seg_lens),
-        "full chain": lambda: region_dispatch(words, region, 0, True, params),
-    }
-    # split halves if present (the fused TPU path may not expose them)
-    halves = getattr(seg, "halves", None)
-    if halves is not None:
-        scan_half, compact_half = halves
-        sh_out = scan_half(words, w_off, sh8, real_blocks)
-        jax.block_until_ready(sh_out)
-        stages["scan_half"] = lambda: scan_half(words, w_off, sh8,
-                                                real_blocks)
-        stages["compact_half"] = lambda: compact_half(
-            *sh_out, words, w_off, sh8, real_blocks, tail_len, starts,
-            seg_lens)
-
-    # -- pass-B sub-stages (replicates scan_half's internals) -------------
-    import jax.numpy as jnp
-
-    from dfs_tpu.ops.cdc_v2 import (gear_candidates_device,
-                                    select_cuts_device)
-    from dfs_tpu.ops.layout import bswap_transpose
-    from dfs_tpu.ops.sha256_strip import cut_state_rows, strip_states
-
-    cp = params.chunk
     lane_words = cp.strip_blocks * 16
 
     @jax.jit
-    def repack(words, w_off, sh8):
-        x = jax.vmap(lambda o: jax.lax.dynamic_slice(
-            words, (o,), (lane_words + 1,)))(w_off)
-        sh = sh8[:, None]
-        packed = jnp.where(
-            sh == 0, x[:, :-1],
-            (x[:, :-1] >> sh) | (x[:, 1:] << (jnp.uint32(32) - sh)))
-        return bswap_transpose(packed)
+    def repack_t(words, w_off, sh8):
+        return bswap_transpose(repack_lanes(words, w_off, sh8, lane_words))
 
-    words_t = repack(words, w_off, sh8)
+    words_t = jax.block_until_ready(repack_t(words, w_off, sh8))
 
     @jax.jit
-    def cand_sel(words_t, real_blocks):
-        cand = gear_candidates_device(words_t, cp)
-        cutflag, since = select_cuts_device(cand, real_blocks, cp)
-        return cutflag.astype(jnp.int32), since
+    def fused_only(words_t, real_blocks):
+        return strip_chunk_states(words_t, real_blocks, cp.seed, cp.mask,
+                                  cp.min_blocks, cp.max_blocks)
 
-    cf32, since = cand_sel(words_t, real_blocks)
+    jax.block_until_ready(fused_only(words_t, real_blocks))
 
-    @jax.jit
-    def strip_only(words_t, cf32):
-        return strip_states(words_t, cf32)
+    stages = [
+        ("anchor", lambda: anchor(words)),
+        ("select", lambda: select(tiles, z, n, fin)),
+        ("descriptors", lambda: desc(bounds, z)),
+        ("scan_half", lambda: scan_half(words, w_off, sh8, real_blocks)),
+        ("compact_half", lambda: compact_half(
+            *sh_out, words, w_off, sh8, real_blocks, tail_len, starts,
+            seg_lens)),
+        ("  repack+bswapT", lambda: repack_t(words, w_off, sh8)),
+        ("  fused cand+sel+SHA", lambda: fused_only(words_t, real_blocks)),
+        ("full chain", lambda: region_dispatch(words, region, 0, True,
+                                               params)),
+    ]
+    for _, fn in stages:
+        jax.block_until_ready(fn())          # compile everything first
 
-    states = strip_only(words_t, cf32)
-
-    @jax.jit
-    def relayout(states):
-        return cut_state_rows(states, s_pad)
-
-    jax.block_until_ready(states)
-    stages["  repack+bswapT"] = lambda: repack(words, w_off, sh8)
-    stages["  cand+select"] = lambda: cand_sel(words_t, real_blocks)
-    stages["  strip SHA"] = lambda: strip_only(words_t, cf32)
-    stages["  cut_state_rows"] = lambda: relayout(states)
-
-    # -- compact_half sub-stages ------------------------------------------
-    from dfs_tpu.ops.cdc_pipeline import cut_capacity
-    from dfs_tpu.ops.sha256_strip import pad_finalize_device
-
-    bps = cp.strip_blocks
-    c_max = min(cut_capacity(s_pad, cp),
-                (m_words // 16 + s_pad) // cp.min_blocks + s_pad)
-    t_tile = 128 if bps % 128 == 0 else bps
-    k_max = t_tile // cp.min_blocks + 2
-    print(f"c_max={c_max} t_tile={t_tile} k_max={k_max}", file=sys.stderr)
-
-    @jax.jit
-    def tile_extract(cf32):
-        flat = cf32.T.reshape(-1, t_tile) != 0
-        nt = flat.shape[0]
-        iota = jnp.arange(t_tile, dtype=jnp.int32)[None, :]
-        cnt = jnp.sum(flat, axis=1).astype(jnp.int32)
-        base = jnp.cumsum(cnt) - cnt
-        poss = []
-        cur = flat
-        for _ in range(k_max):
-            pos = jnp.min(jnp.where(cur, iota, t_tile), axis=1)
-            poss.append(pos)
-            cur = cur & (iota != pos[:, None])
-        pos_mat = jnp.stack(poss, axis=1)
-        valid = pos_mat < t_tile
-        gidx = jnp.where(
-            valid,
-            base[:, None] + jnp.arange(k_max, dtype=jnp.int32)[None, :],
-            c_max)
-        vals = jnp.arange(nt, dtype=jnp.int32)[:, None] * t_tile + pos_mat
-        q = jnp.full((c_max,), -1, jnp.int32).at[gidx.reshape(-1)].set(
-            vals.reshape(-1).astype(jnp.int32), mode="drop")
-        return q
-
-    state_rows = relayout(states)
-    q_dev = tile_extract(cf32)
-
-    @jax.jit
-    def gathers_finalize(q, since, state_rows, real_blocks, tail_len):
-        t = jnp.maximum(q, 0) % bps
-        s = jnp.maximum(q, 0) // bps
-        blocks = jnp.take(since.reshape(-1), t * jnp.int32(s_pad) + s)
-        is_tail = (t == jnp.take(real_blocks, s) - 1) \
-            & (jnp.take(tail_len, s) > 0)
-        from dfs_tpu.ops.cdc_v2 import BLOCK
-        lens = blocks * jnp.int32(BLOCK) \
-            - jnp.where(is_tail, jnp.int32(BLOCK) - jnp.take(tail_len, s), 0)
-        cut_states = jnp.take(state_rows, t * jnp.int32(s_pad) + s, axis=0)
-        return pad_finalize_device(cut_states, lens)
-
-    jax.block_until_ready(gathers_finalize(q_dev, since, state_rows,
-                                           real_blocks, tail_len))
-    stages["  tile_extract"] = lambda: tile_extract(cf32)
-    stages["  gather+final"] = lambda: gathers_finalize(
-        q_dev, since, state_rows, real_blocks, tail_len)
-
-    # -- full-chain variants: piece cost = full - variant -----------------
-    for variant in ("full", "no_tail", "tight", "fused"):
-        stages[f"chain[{variant}]"] = (
-            lambda v=variant: region_dispatch(words, region, 0, True,
-                                              params, _variant=v))
+    acc = {name: ([], []) for name, _ in stages}
+    for rep in range(reps):
+        if rep:
+            time.sleep(0.3)
+        for name, fn in stages:
+            for k, a in ((3, acc[name][0]), (12, acc[name][1])):
+                jax.block_until_ready(fn())
+                t0 = time.perf_counter()
+                out = None
+                for _ in range(k):
+                    out = fn()
+                jax.block_until_ready(out)
+                a.append(time.perf_counter() - t0)
 
     total_ms = None
-    for name, fn in stages.items():
-        fn()  # compile
-        dt = slope(fn, passes, reps)
-        gib = region / dt / 2**30
-        print(f"{name:>16}: {dt * 1e3:7.2f} ms  ({gib:6.2f} GiB/s)",
-              file=sys.stderr)
+    for name, _ in stages:
+        lo, hi = acc[name]
+        dt = (min(hi) - min(lo)) / 9
+        if dt <= 0:
+            # sub-jitter stage: the 9-dispatch delta drowned in sync
+            # noise — report as below measurement floor, not a negative
+            print(f"{name:>22}:  <0.05 ms  (below noise floor)",
+                  file=sys.stderr)
+            continue
+        print(f"{name:>22}: {dt * 1e3:7.2f} ms  "
+              f"({region / dt / 2**30:6.2f} GiB/s)", file=sys.stderr)
         if name == "full chain":
             total_ms = dt * 1e3
-    print(f"TOTAL {total_ms:.2f} ms -> {region / (total_ms / 1e3) / 2**30:.2f}"
-          f" GiB/s", file=sys.stderr)
+    if total_ms:
+        print(f"TOTAL {total_ms:.2f} ms -> "
+              f"{region / (total_ms / 1e3) / 2**30:.2f} GiB/s",
+              file=sys.stderr)
+    else:
+        print("TOTAL below noise floor — rerun", file=sys.stderr)
     return 0
 
 

@@ -204,6 +204,8 @@ def _strip_fused_kernel(words_ref, rb_ref, out_ref, cf_ref, since_ref,
     scratch: state [8, R, 128], carry(since) [1, R, 128]."""
     from jax.experimental import pallas as pl
 
+    from dfs_tpu.ops.cdc_v2 import _M1, _M2, _PRIME
+
     t0 = pl.program_id(0) * unroll
 
     @pl.when(pl.program_id(0) == 0)
@@ -212,15 +214,13 @@ def _strip_fused_kernel(words_ref, rb_ref, out_ref, cf_ref, since_ref,
             state_ref[i] = jnp.full_like(state_ref[i], jnp.uint32(_H0[i]))
         carry_ref[0] = jnp.zeros_like(carry_ref[0])
 
-    prime = np.uint32(0x9E3779B1)
-    m1 = np.uint32(0x7FEB352D)
-    m2 = np.uint32(0x846CA68B)
-
     def fmix(x):
+        # lowbias32, shared constants with the staged Gear pass — the
+        # fused and staged paths must stay bit-identical
         x = x ^ (x >> np.uint32(16))
-        x = x * m1
+        x = x * _M1
         x = x ^ (x >> np.uint32(15))
-        x = x * m2
+        x = x * _M2
         return x ^ (x >> np.uint32(16))
 
     rb = rb_ref[...]
@@ -234,7 +234,7 @@ def _strip_fused_kernel(words_ref, rb_ref, out_ref, cf_ref, since_ref,
         for j in range(32):
             byte = (w[8 + j // 4] >> np.uint32(8 * (3 - j % 4))) \
                 & np.uint32(0xFF)
-            g = fmix(np.uint32(seed) ^ (byte * prime))
+            g = fmix(np.uint32(seed) ^ (byte * _PRIME))
             h = h + (g << np.uint32(31 - j))
         cand = (h & np.uint32(mask)) == 0
         # greedy selection step (ops.cdc_v2.select_cuts_device semantics)

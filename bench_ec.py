@@ -29,8 +29,12 @@ def log(msg: str) -> None:
 
 def main() -> int:
     k = int(sys.argv[1]) if len(sys.argv) > 1 else 8
-    shard = (int(sys.argv[2]) if len(sys.argv) > 2 else 8) * 2**20
-    reps = int(sys.argv[3]) if len(sys.argv) > 3 else 10
+    # 32 MiB shards: a 256 MiB stripe set makes the k-chain window large
+    # vs the tunnel's sync jitter — at 8 MiB the sub-ms encode drowned
+    # in it (observed 12-242 GiB/s run to run; this shape repeats within
+    # ~15%)
+    shard = (int(sys.argv[2]) if len(sys.argv) > 2 else 32) * 2**20
+    reps = int(sys.argv[3]) if len(sys.argv) > 3 else 12
 
     from dfs_tpu.ops.ec import _make_encode_fn, encode_pq_np
 
@@ -55,7 +59,7 @@ def main() -> int:
 
     # difference-of-mins slope, same discipline as bench.py
     t_lo, t_hi = [], []
-    k_lo, k_hi = 2, 10
+    k_lo, k_hi = 3, 18
     for rep in range(reps):
         if rep:
             time.sleep(0.4)

@@ -117,26 +117,46 @@ def encode_pq_np(shards: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return p.view(np.uint8), q.view(np.uint8)
 
 
+def xtime_device(x):
+    """GF doubling on u32 words holding 4 independent byte lanes (the
+    device twin of :func:`_xtime_np`; shared by the single-chip encode
+    and the sharded mesh step in parallel.sharded_cdc)."""
+    import jax.numpy as jnp
+
+    hi = x & jnp.uint32(0x80808080)
+    lo = (x ^ hi) << jnp.uint32(1)
+    return lo ^ ((hi >> jnp.uint32(7)) * jnp.uint32(_POLY))
+
+
+def pq_horner(shards, k: int, axis: int = 0):
+    """The P/Q recurrence on device arrays: xor-accumulate P and Horner
+    Q (``q = xtime(q) ^ d_i``) over the ``k`` shards along ``axis``.
+    THE single definition of the parity math on device — the
+    single-chip encode and the sharded mesh step
+    (parallel.sharded_cdc.make_ec_step) both call it, so they cannot
+    drift from each other (or from :func:`encode_pq_np`, the oracle)."""
+    import jax.numpy as jnp
+
+    take = (lambda i: shards[i]) if axis == 0 \
+        else (lambda i: jnp.take(shards, i, axis=axis))
+    p = take(0)
+    q = take(0)                            # q0 = xtime(0) ^ d0 = d0
+    for i in range(1, k):                  # k is static and small
+        d = take(i)
+        p = p ^ d
+        q = xtime_device(q) ^ d
+    return p, q
+
+
 @functools.cache
 def _make_encode_fn(k: int):
     """Compiled device encode for a k-shard stripe: words [k, n] u32 ->
     (p [n] u32, q [n] u32). Pure bitwise VPU ops — no tables."""
     import jax
-    import jax.numpy as jnp
-
-    def xtime(x):
-        hi = x & jnp.uint32(0x80808080)
-        lo = (x ^ hi) << jnp.uint32(1)
-        return lo ^ ((hi >> jnp.uint32(7)) * jnp.uint32(_POLY))
 
     @jax.jit
     def run(words):
-        p = jnp.zeros_like(words[0])
-        q = jnp.zeros_like(words[0])
-        for i in range(k):                 # k is static and small
-            p = p ^ words[i]
-            q = xtime(q) ^ words[i]
-        return p, q
+        return pq_horner(words, k)
 
     return run
 

@@ -368,3 +368,45 @@ def anchored_sharded_parity_check(mesh: Mesh, n_devices: int) -> None:
             prev = c
     if spans != chunk_spans_anchored_np(data, params):
         raise AssertionError("anchored sharded spans != oracle spans")
+
+
+# ---------------------------------------------------------------------------
+# erasure parity, sharded — stripes are independent; pure data parallelism
+# ---------------------------------------------------------------------------
+
+def make_ec_step(mesh: Mesh, k: int):
+    """Multi-device erasure-parity encode (ops.ec P+Q over GF(256)).
+
+    Stripes encode independently, so the stripe axis shards over the
+    whole flattened ('dp','sp') mesh with ZERO collectives on the data
+    path — parity is xor + the xtime funnel per stripe, memory-bound
+    VPU work on every device at once. The only collective is the psum'd
+    parity-byte telemetry (what the node runtime reports as
+    ecParityBytes).
+
+    step(stripes [NS, k, n] u32 — stripe axis sharded)
+      -> (p [NS, n] u32, q [NS, n] u32 (same sharding),
+          parity_bytes [] i64-ish i32 (global psum))
+    """
+    from dfs_tpu.ops.ec import pq_horner
+
+    def local_step(stripes):
+        p, q = pq_horner(stripes, k, axis=1)
+        nbytes = jax.lax.psum(jax.lax.psum(
+            jnp.int32(2 * 4) * stripes.shape[0] * stripes.shape[2],
+            "sp"), "dp")
+        return p, q, nbytes
+
+    shard_fn = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(("dp", "sp")),),
+        out_specs=(P(("dp", "sp")), P(("dp", "sp")), P()),
+        check_vma=False,
+    )
+    return jax.jit(shard_fn)
+
+
+def shard_ec_inputs(mesh: Mesh, stripes: np.ndarray):
+    """device_put EC-step input with stripe-axis sharding."""
+    return jax.device_put(
+        stripes, NamedSharding(mesh, P(("dp", "sp"))))

@@ -137,6 +137,11 @@ def pq_horner(shards, k: int, axis: int = 0):
     drift from each other (or from :func:`encode_pq_np`, the oracle)."""
     import jax.numpy as jnp
 
+    if shards.shape[axis] != k:
+        # jnp.take CLAMPS out-of-range indices under jit — a k/shape
+        # mismatch would return wrong parity silently instead of raising
+        raise ValueError(
+            f"{shards.shape[axis]} shards along axis {axis}, expected {k}")
     take = (lambda i: shards[i]) if axis == 0 \
         else (lambda i: jnp.take(shards, i, axis=axis))
     p = take(0)

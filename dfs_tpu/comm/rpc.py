@@ -86,16 +86,31 @@ class InternalClient:
                 writer.close()
         self._pool.clear()
 
-    async def _request(self, conn, header: dict,
-                       body: bytes) -> tuple[dict, bytes]:
+    # bulk transfers budget extra time per byte on top of the base
+    # request timeout: a 32 MiB store slice plus its server-side hash
+    # echo blew a flat 10 s budget on a contended 1-core host (every
+    # peer "timed out", failing a 2 GiB upload below quorum). 1 MB/s is
+    # the assumed worst-case effective bandwidth — GiB-class ingest on
+    # one core measured 4-8 MB/s end to end (the receiver creates one
+    # file per chunk; fs metadata dominates) with multi-second writeback
+    # stalls on top
+    _BULK_BYTES_PER_S = 1024 * 1024
+
+    def _bulk_timeout(self, n_bytes: int) -> float:
+        return self.request_timeout_s + n_bytes / self._BULK_BYTES_PER_S
+
+    async def _request(self, conn, header: dict, body: bytes,
+                       timeout_s: float | None = None) -> tuple[dict, bytes]:
+        t = self.request_timeout_s if timeout_s is None \
+            else max(self.request_timeout_s, timeout_s)
         _, writer = conn
-        await asyncio.wait_for(send_msg(writer, header, body),
-                               timeout=self.request_timeout_s)
-        return await asyncio.wait_for(
-            read_msg(conn[0]), timeout=self.request_timeout_s)
+        await asyncio.wait_for(send_msg(writer, header, body), timeout=t)
+        return await asyncio.wait_for(read_msg(conn[0]), timeout=t)
 
     async def _call_once(self, peer: PeerAddr, header: dict,
-                         body: bytes) -> tuple[dict, bytes]:
+                         body: bytes,
+                         timeout_s: float | None = None
+                         ) -> tuple[dict, bytes]:
         conn = self._checkout(peer)
         reused = conn is not None
         if conn is None:
@@ -103,7 +118,7 @@ class InternalClient:
                 asyncio.open_connection(peer.host, peer.internal_port),
                 timeout=self.connect_timeout_s)
         try:
-            resp, rbody = await self._request(conn, header, body)
+            resp, rbody = await self._request(conn, header, body, timeout_s)
         except (ConnectionError, asyncio.IncompleteReadError, WireError):
             # disconnect-class only: a pooled connection the server closed
             # while idle surfaces as reset/EOF on the first frame, and is
@@ -118,7 +133,8 @@ class InternalClient:
                 asyncio.open_connection(peer.host, peer.internal_port),
                 timeout=self.connect_timeout_s)
             try:
-                resp, rbody = await self._request(conn, header, body)
+                resp, rbody = await self._request(conn, header, body,
+                                                  timeout_s)
             except BaseException:
                 conn[1].close()
                 raise
@@ -135,15 +151,18 @@ class InternalClient:
 
     async def call(self, peer: PeerAddr, header: dict,
                    body: bytes = b"",
-                   retries: int | None = None) -> tuple[dict, bytes]:
+                   retries: int | None = None,
+                   timeout_s: float | None = None) -> tuple[dict, bytes]:
         """Bounded-retry call (reference: 3 attempts, StorageNode.java:208).
         ``retries`` overrides the default — the node runtime passes 1 for
-        peers its health monitor believes are dead (fast-fail probe)."""
+        peers its health monitor believes are dead (fast-fail probe).
+        ``timeout_s`` raises (never lowers) the per-attempt budget —
+        bulk ops pass a size-derived value (:meth:`_bulk_timeout`)."""
         attempts = retries if retries is not None else self.retries
         last: Exception | None = None
         for attempt in range(attempts):
             try:
-                return await self._call_once(peer, header, body)
+                return await self._call_once(peer, header, body, timeout_s)
             except RpcError:
                 raise  # application-level error: retrying won't help
             except (OSError, asyncio.TimeoutError, RuntimeError) as e:
@@ -151,7 +170,8 @@ class InternalClient:
                 if attempt + 1 < attempts:
                     await asyncio.sleep(0.05 * (attempt + 1))
         raise RpcUnreachable(
-            f"peer {peer.node_id} unreachable after {attempts} attempts: {last}")
+            f"peer {peer.node_id} unreachable after {attempts} attempts: "
+            f"{type(last).__name__}: {last}")   # TimeoutError strs empty
 
     # ---- typed ops ----
 
@@ -161,7 +181,8 @@ class InternalClient:
         reference contract StorageNode.java:248-257). Caller verifies."""
         table, body = pack_chunks(chunks)
         resp, _ = await self.call(
-            peer, {"op": "store_chunks", "fileId": file_id, "chunks": table}, body)
+            peer, {"op": "store_chunks", "fileId": file_id, "chunks": table},
+            body, timeout_s=self._bulk_timeout(len(body)))
         return list(resp.get("digests", []))
 
     async def announce(self, peer: PeerAddr, manifest_json: str,
@@ -178,15 +199,17 @@ class InternalClient:
         return body
 
     async def get_chunks(self, peer: PeerAddr, digests: list[str],
-                         retries: int | None = None
+                         retries: int | None = None,
+                         expect_bytes: int = 0
                          ) -> list[tuple[str, bytes]]:
         """Batched fetch: returns (digest, bytes) for every requested
         chunk the peer holds (missing ones are absent — no error).
         ``retries`` as in :meth:`call` (callers pass 1 for known-dead
-        peers)."""
+        peers); ``expect_bytes`` sizes the timeout for the expected
+        response payload."""
         resp, body = await self.call(
             peer, {"op": "get_chunks", "digests": digests},
-            retries=retries)
+            retries=retries, timeout_s=self._bulk_timeout(expect_bytes))
         return unpack_chunks(resp.get("chunks", []), body)
 
     async def get_manifest(self, peer: PeerAddr, file_id: str

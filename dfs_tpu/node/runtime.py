@@ -196,19 +196,40 @@ class StorageNodeServer:
         if op == "store_chunks":
             # Hash echo: recompute every digest from the received bytes
             # (reference receiver contract, StorageNode.java:279-292).
+            # The hash + thousands of file writes run OFF the event loop:
+            # inline they occupied it for seconds under writeback
+            # pressure (observed on a 2 GiB-corpus ingest), so the node
+            # answered NOTHING and every peer cascaded into "unreachable"
+            # — the same rule upload/download/scrub already follow.
             pairs = unpack_chunks(header.get("chunks", []), body)
-            echoed = sha256_many_hex([b for _, b in pairs])
-            for (claimed, data), actual in zip(pairs, echoed):
-                if claimed == actual:
-                    if self.store.chunks.put(actual, data, verify=False):
-                        self.counters.inc("chunks_stored")
-                        self.counters.inc("bytes_stored", len(data))
-                    else:
-                        self.counters.inc("dedup_hits")
+
+            def store_all():
+                echoed = sha256_many_hex([b for _, b in pairs])
+                stored = dedup = 0
+                nbytes = 0
+                for (claimed, data), actual in zip(pairs, echoed):
+                    if claimed == actual:
+                        if self.store.chunks.put(actual, data,
+                                                 verify=False):
+                            stored += 1
+                            nbytes += len(data)
+                        else:
+                            dedup += 1
+                return echoed, stored, dedup, nbytes
+
+            echoed, stored, dedup, nbytes = await asyncio.to_thread(
+                store_all)
+            if stored:
+                self.counters.inc("chunks_stored", stored)
+                self.counters.inc("bytes_stored", nbytes)
+            if dedup:
+                self.counters.inc("dedup_hits", dedup)
             return {"ok": True, "digests": echoed}, b""
         if op == "has_chunks":
             digests = header.get("digests", [])
-            have = [d for d in digests if self.store.chunks.has(d)]
+            # tens of thousands of stat() calls — off the loop
+            have = await asyncio.to_thread(
+                lambda: [d for d in digests if self.store.chunks.has(d)])
             return {"ok": True, "have": have}, b""
         if op == "announce":
             m = Manifest.from_json(header["manifest"])
@@ -239,8 +260,10 @@ class StorageNodeServer:
             # node holds (the per-chunk op costs a full RPC round-trip per
             # chunk — the dominant cost of degraded reads at small chunk
             # sizes). Missing digests are simply absent from the table.
-            have = [(d, b) for d in header.get("digests", [])
-                    if (b := self.store.chunks.get(d)) is not None]
+            digests = header.get("digests", [])
+            have = await asyncio.to_thread(
+                lambda: [(d, b) for d in digests
+                         if (b := self.store.chunks.get(d)) is not None])
             table, body = pack_chunks(have)
             return {"ok": True, "chunks": table}, body
         if op == "get_manifest":
@@ -615,6 +638,26 @@ class StorageNodeServer:
                 "dedupSkippedBytes": 0, "minCopies": None,
                 "handoffChunks": 0, "degraded": False}
 
+    @staticmethod
+    def _slice_payloads(items: list[tuple[str, bytes]],
+                        max_bytes: int = 8 * 1024 * 1024
+                        ) -> list[list[tuple[str, bytes]]]:
+        """Split (digest, payload) lists into <= max_bytes slices (always
+        at least one item per slice) so no single RPC carries unbounded
+        bytes — the receiver hash-echoes a whole call before replying."""
+        out: list[list[tuple[str, bytes]]] = []
+        cur: list[tuple[str, bytes]] = []
+        size = 0
+        for d, b in items:
+            if cur and size + len(b) > max_bytes:
+                out.append(cur)
+                cur, size = [], 0
+            cur.append((d, b))
+            size += len(b)
+        if cur:
+            out.append(cur)
+        return out
+
     async def _place_batch(self, file_id: str,
                            batch: list[tuple[str, bytes]],
                            stats: dict, rf: int | None = None,
@@ -682,14 +725,23 @@ class StorageNodeServer:
                         stats["dedupSkippedBytes"] += len(b)
                         self.counters.inc("dedup_remote_hits")
                 if missing:
-                    echoed = await self.client.store_chunks(
-                        peer, file_id, missing)
-                    sent = {d for d, _ in missing}
-                    verified = sent & set(echoed)
-                    if verified != sent:
-                        raise RpcError(
-                            f"hash echo mismatch from node {node_id}")
-                    stats["transferredBytes"] += sum(len(b) for _, b in missing)
+                    # bounded RPCs: the receiver recomputes the hash echo
+                    # of everything in one call before replying, so an
+                    # unbounded payload turns into an unbounded server
+                    # pass — a ~300 MB push under 1-core contention blew
+                    # the request timeout and failed a whole 2 GiB-corpus
+                    # upload below quorum; <=32 MiB slices keep each
+                    # call's work (and any retry's re-send) small
+                    for part in self._slice_payloads(missing):
+                        echoed = await self.client.store_chunks(
+                            peer, file_id, part)
+                        sent = {d for d, _ in part}
+                        verified = sent & set(echoed)
+                        if verified != sent:
+                            raise RpcError(
+                                f"hash echo mismatch from node {node_id}")
+                        stats["transferredBytes"] += sum(
+                            len(b) for _, b in part)
                 for d in digests:
                     copies[d] += 1
                 self.health.mark_alive(node_id)
@@ -907,7 +959,8 @@ class StorageNodeServer:
                     got = await self.client.get_chunks(
                         peer, batch,
                         retries=None if self.health.is_alive(node_id)
-                        else 1)
+                        else 1,
+                        expect_bytes=sum(need[d] for d in batch))
                     self.health.mark_alive(node_id)
                 except RpcUnreachable:
                     self.health.mark_dead(node_id)
@@ -1544,12 +1597,16 @@ class StorageNodeServer:
                     payload.append((d, b))
                 if payload:
                     # Hash-echo verification, same contract as upload
-                    # (StorageNode.java:248-257): only echoed digests count.
-                    echoed = set(await self.client.store_chunks(
-                        peer, "", payload))
-                    ok = {d for d, _ in payload} & echoed
-                    repaired += len(ok)
-                    verified |= ok
+                    # (StorageNode.java:248-257): only echoed digests
+                    # count. Bounded slices like upload's replicate — a
+                    # repair push after a big membership change can carry
+                    # most of a corpus.
+                    for part in self._slice_payloads(payload):
+                        echoed = set(await self.client.store_chunks(
+                            peer, "", part))
+                        ok = {d for d, _ in part} & echoed
+                        repaired += len(ok)
+                        verified |= ok
             except RpcError:
                 continue
         # only drop repair entries we actually confirmed on a peer

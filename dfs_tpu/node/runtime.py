@@ -77,7 +77,32 @@ def ec_placement_map(manifest: Manifest,
     of an erasure-coded manifest. Derived from the manifest alone
     (node.placement.ec_shard_node), so any node can locate any shard.
     A digest appearing in several stripes (dedup within the file) gets
-    the union of its slots' holders."""
+    the union of its slots' holders. Memoized per (manifest layout,
+    membership): rebuilding measured ~30 ms per gather on a 32 MiB
+    manifest, and a degraded read runs two gathers. The key is a cheap
+    layout fingerprint, not the manifest object — hashing a frozen
+    dataclass walks every ChunkRef, which would cost as much as the
+    rebuild; stripe endpoints pin the ec_k re-upload case where the
+    same file_id maps to a different stripe layout."""
+    ec = manifest.ec
+    assert ec is not None
+    key = (manifest.file_id, ec.k, len(manifest.chunks), len(ec.stripes),
+           ec.stripes[0].p if ec.stripes else "",
+           ec.stripes[-1].q if ec.stripes else "", tuple(node_ids))
+    hit = _EC_PLACEMENT_CACHE.get(key)
+    if hit is None:
+        hit = _ec_placement_build(manifest, list(node_ids))
+        if len(_EC_PLACEMENT_CACHE) >= 64:
+            _EC_PLACEMENT_CACHE.pop(next(iter(_EC_PLACEMENT_CACHE)))
+        _EC_PLACEMENT_CACHE[key] = hit
+    return hit
+
+
+_EC_PLACEMENT_CACHE: dict = {}
+
+
+def _ec_placement_build(manifest: Manifest, node_ids: list[int]
+                        ) -> dict[str, list[int]]:
     ec = manifest.ec
     assert ec is not None
     pl: dict[str, list[int]] = {}
@@ -277,9 +302,12 @@ class StorageNodeServer:
             self.store.gc()
             return {"ok": True}, b""
         if op == "health":
+            # counts must be O(1)/filename-only: every peer probes this
+            # op every few seconds, and the full digests()+manifest-parse
+            # scan measured ~40% of read throughput at a 175K-chunk store
             return {"ok": True, "nodeId": self.cfg.node_id,
-                    "chunks": len(self.store.chunks.digests()),
-                    "files": len(self.store.manifests.list())}, b""
+                    "chunks": self.store.chunks.count(),
+                    "files": len(self.store.manifests.ids())}, b""
         return {"ok": False, "error": f"unknown op {op!r}"}, b""
 
     # ------------------------------------------------------------------ #
@@ -1132,64 +1160,106 @@ class StorageNodeServer:
                 manifest, chunks=list(fetch.values()), strict=False,
                 ec_fallback=False)
             have.update(got)
-        for s, st, grp in affected:
+        def padded(d: str, ln: int, shard_len: int) -> np.ndarray | None:
+            # `out` first: a digest shared between stripes (in-file
+            # dedup) may have been recovered by an earlier batch of
+            # this very pass — the pre-fetch snapshot would still
+            # count it lost and push the stripe past the P+Q budget
+            b = out.get(d)
+            if b is None:
+                b = have.get(d)
+            if b is None or len(b) != ln:
+                return None
+            arr = np.zeros(shard_len, dtype=np.uint8)
+            arr[:ln] = np.frombuffer(b, dtype=np.uint8)
+            return arr
 
-            def padded(d: str, ln: int) -> np.ndarray | None:
-                # `out` first: a digest shared between stripes (in-file
-                # dedup) may have been recovered by an earlier stripe of
-                # this very pass — the pre-fetch snapshot would still
-                # count it lost and push the stripe past the P+Q budget
-                b = out.get(d)
-                if b is None:
-                    b = have.get(d)
-                if b is None or len(b) != ln:
-                    return None
-                arr = np.zeros(st.shard_len, dtype=np.uint8)
-                arr[:ln] = np.frombuffer(b, dtype=np.uint8)
-                return arr
-
-            data = [padded(c.digest, c.length) for c in grp]
-            p = padded(st.p, st.shard_len)
-            q = padded(st.q, st.shard_len)
-            lost = sum(d is None for d in data) \
-                + (p is None) + (q is None)
-            if lost > 2:
-                self.log.warning(
-                    "ec stripe %d of %s: %d shards lost, beyond P+Q",
-                    s, manifest.file_id[:12], lost)
-                continue
-            if any(d is None for d in data):
-                try:
-                    rec = await asyncio.to_thread(
-                        ec_ops.recover_stripe, data, p, q)
-                except ValueError as e:
-                    self.log.warning("ec decode failed for stripe %d of "
-                                     "%s: %s", s, manifest.file_id[:12], e)
+        # All affected stripes decode in ONE vectorized batch
+        # (ec_ops.recover_stripes) instead of a sequential per-stripe
+        # loop — 1,398 host decodes for a 64 MiB two-dead-node read
+        # measured 3x slower than a healthy read; the batch solve is one
+        # xor/Horner pass over an [S, k, W] stack. A stripe whose budget
+        # depends on a shard another stripe of this batch recovers
+        # (in-file dedup) defers to the next round of the loop.
+        pending = affected
+        while pending:
+            deferred = []
+            inputs = []
+            meta = []
+            for s, st, grp in pending:
+                data = [padded(c.digest, c.length, st.shard_len)
+                        for c in grp]
+                p = padded(st.p, st.shard_len, st.shard_len)
+                q = padded(st.q, st.shard_len, st.shard_len)
+                lost = sum(d is None for d in data) \
+                    + (p is None) + (q is None)
+                if lost > 2:
+                    deferred.append((s, st, grp, lost))
                     continue
-            else:
-                rec = data
-            recovered = False
-            for c, arr in zip(grp, rec):
-                if c.digest in wanted and c.digest not in out:
-                    b = arr[:c.length].tobytes()
-                    if sha256_hex(b) == c.digest:
-                        out[c.digest] = b
-                        recovered = True
-                    else:
-                        self.log.error(
-                            "ec decode produced wrong digest for %s",
-                            c.digest[:12])
-            if (st.p in wanted and st.p not in out) \
-                    or (st.q in wanted and st.q not in out):
-                full = np.stack([np.asarray(a) for a in rec])
-                pb, qb = ec_ops.encode_pq(full, device=False)
-                for d, b in ((st.p, pb.tobytes()), (st.q, qb.tobytes())):
-                    if d in wanted and d not in out \
-                            and sha256_hex(b) == d:
-                        out[d] = b
-                        recovered = True
-            if recovered:
-                self.counters.inc("ec_decodes")
+                inputs.append((data, p, q))
+                meta.append((s, st, grp))
+            recs = []
+            if inputs:
+                try:
+                    recs = await asyncio.to_thread(
+                        ec_ops.recover_stripes, inputs)
+                except ValueError as e:
+                    # fall back to per-stripe so one malformed stripe
+                    # cannot sink the others (off-loop like the batch —
+                    # thousands of inline decodes would stall the server)
+                    self.log.warning("ec batch decode failed (%s); "
+                                     "retrying per stripe", e)
+
+                    def _per_stripe():
+                        got = []
+                        for data, p, q in inputs:
+                            try:
+                                got.append(
+                                    ec_ops.recover_stripe(data, p, q))
+                            except ValueError as e2:
+                                got.append(None)
+                                self.log.warning("ec decode failed: %s",
+                                                 e2)
+                        return got
+
+                    recs = await asyncio.to_thread(_per_stripe)
+            progress = False
+            for (s, st, grp), rec in zip(meta, recs):
+                if rec is None:
+                    continue
+                recovered = False
+                for c, arr in zip(grp, rec):
+                    if c.digest in wanted and c.digest not in out:
+                        b = arr[:c.length].tobytes()
+                        if sha256_hex(b) == c.digest:
+                            out[c.digest] = b
+                            recovered = True
+                        else:
+                            self.log.error(
+                                "ec decode produced wrong digest for %s",
+                                c.digest[:12])
+                if (st.p in wanted and st.p not in out) \
+                        or (st.q in wanted and st.q not in out):
+                    full = np.stack([np.asarray(a) for a in rec])
+                    pb, qb = ec_ops.encode_pq(full, device=False)
+                    for d, b in ((st.p, pb.tobytes()),
+                                 (st.q, qb.tobytes())):
+                        if d in wanted and d not in out \
+                                and sha256_hex(b) == d:
+                            out[d] = b
+                            recovered = True
+                if recovered:
+                    progress = True
+                    self.counters.inc("ec_decodes")
+            if not deferred:
+                break
+            if not progress:
+                for s, st, grp, lost in deferred:
+                    self.log.warning(
+                        "ec stripe %d of %s: %d shards lost, beyond P+Q",
+                        s, manifest.file_id[:12], lost)
+                break
+            pending = [(s, st, grp) for s, st, grp, _ in deferred]
 
     async def _resolve_manifest(self, file_id: str) -> Manifest:
         manifest = self.store.manifests.load(file_id)

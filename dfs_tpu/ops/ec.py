@@ -95,11 +95,20 @@ def _gf_mul_bytes(c: int, x: np.ndarray) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 def _xtime_np(x: np.ndarray) -> np.ndarray:
-    """GF doubling on u32 words holding 4 independent byte lanes."""
-    x = x.astype(np.uint32)
-    hi = x & np.uint32(0x80808080)
-    lo = (x ^ hi) << np.uint32(1)
-    return lo ^ ((hi >> np.uint32(7)) * np.uint32(_POLY))
+    """GF doubling on u32 words holding 4 independent byte lanes.
+
+    Written with explicit ``out=`` so the whole pass allocates two
+    temporaries instead of six — this is the inner op of every Horner
+    and scalar-multiply pass in the batched decode, where the naive
+    form measured ~20% of a degraded read."""
+    x = x.astype(np.uint32, copy=False)
+    hi = np.bitwise_and(x, np.uint32(0x80808080))
+    lo = np.bitwise_xor(x, hi)
+    np.left_shift(lo, np.uint32(1), out=lo)
+    np.right_shift(hi, np.uint32(7), out=hi)
+    np.multiply(hi, np.uint32(_POLY), out=hi)
+    np.bitwise_xor(lo, hi, out=lo)
+    return lo
 
 
 def encode_pq_np(shards: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -201,7 +210,11 @@ def recover_stripe(data: list[np.ndarray | None],
     ``None`` for lost shards (present arrays all the same padded length);
     ``p``/``q`` are the parity shards or ``None`` if lost too. Returns
     the complete data list. Raises ValueError when more than two shards
-    (counting lost parity) are missing — beyond P+Q's budget."""
+    (counting lost parity) are missing — beyond P+Q's budget.
+
+    This is the per-stripe ORACLE; production degraded reads batch all
+    affected stripes of a file through :func:`recover_stripes`, which
+    the equivalence tests pin to this function."""
     k = len(data)
     missing = [i for i, d in enumerate(data) if d is None]
     lost = len(missing) + (p is None) + (q is None)
@@ -214,6 +227,15 @@ def recover_stripe(data: list[np.ndarray | None],
     if present is None:
         raise ValueError("nothing to recover from")
     ln = present.shape[0]
+    shapes = {arr.shape[0] for arr in (*data, p, q) if arr is not None}
+    if len(shapes) > 1:
+        raise ValueError(
+            f"present shards have unequal padded lengths {sorted(shapes)}; "
+            "pad every shard of a stripe to the stripe's shard_len")
+    if ln % 4:
+        raise ValueError(
+            f"shard length {ln} is not a multiple of 4; the u32-packed "
+            "GF lanes require stripe_shard_len padding")
 
     def xor_known(skip: set[int]) -> np.ndarray:
         acc = np.zeros(ln, dtype=np.uint8)
@@ -264,3 +286,204 @@ def recover_stripe(data: list[np.ndarray | None],
     out[a] = da
     out[b] = db
     return out  # type: ignore[return-value]
+
+
+# ---------------------------------------------------------------------------
+# batched decode: every affected stripe of a read in one vectorized solve
+# ---------------------------------------------------------------------------
+
+def _gf_mul_const(c: int, x, xp=np):
+    """GF(256) multiply of an array by a COMPILE-TIME constant: c = XOR
+    of 2^b over its set bits, x·2^b is b applications of xtime, so
+    x·c = XOR over set bits of xtime^b(x) — doubling passes only up to
+    c's top bit, xor passes only for set bits, no row masks. The decode
+    groups stripes by their missing-index pattern exactly so these
+    scalars ARE constants (a k-wide code has only ~k²/2 patterns).
+    Identical code for the NumPy and jnp backends."""
+    if not 0 <= c <= 0xFF:
+        raise ValueError(f"GF(256) scalar out of range: {c}")
+    if c == 0:
+        return xp.zeros_like(x)
+    xtime = _xtime_np if xp is np else xtime_device
+    acc = None
+    cur = x
+    b = 0
+    while True:
+        if c >> b & 1:
+            acc = cur if acc is None else acc ^ cur
+        b += 1
+        if not c >> b:
+            return acc
+        cur = xtime(cur)
+
+
+def _solve_group(xp, case_id: int, D, P, Q, ea_inv: int, cb: int,
+                 denom_inv: int, k: int):
+    """Vectorized P+Q solve over one stripe group homogeneous in
+    (k, padded length, erasure case, missing-index pattern).
+
+    D [S, k, W] u32 — data shards, missing slots ZEROED; P/Q [S, W] u32;
+    case_id — 0: single loss with P present (d = X = P ^ xor(data)),
+    1: single loss solved from Q (d = inv(c)·T, T = Q ^ Horner(data)),
+    2: double loss (d_a = inv(ca^cb)·(cb·X ^ T), d_b = X ^ d_a);
+    ea_inv/cb/denom_inv — GROUP-CONSTANT scalar coefficients (the
+    grouping makes the missing pattern, hence these, uniform).
+    Returns (ra, rb); rb only for case 2.
+
+    The case split is load-bearing twice over: a two-dead-node read
+    makes every stripe degraded but only ~1/3 doubly-degraded in DATA —
+    and constant coefficients let the scalar multiplies skip unset bits
+    instead of masking rows (the row-masked form measured ~2x the whole
+    solve). Pure xor/xtime work — identical under NumPy and jnp, so the
+    device path cannot drift from the oracle-tested host path."""
+    if case_id == 0:
+        return P ^ _xor_reduce(xp, D), None
+    if case_id == 1:
+        T = Q ^ _horner_reduce(xp, D, k)
+        return _gf_mul_const(ea_inv, T, xp), None
+    X = P ^ _xor_reduce(xp, D)
+    T = Q ^ _horner_reduce(xp, D, k)
+    ra = _gf_mul_const(denom_inv, _gf_mul_const(cb, X, xp) ^ T, xp)
+    return ra, X ^ ra
+
+
+def _xor_reduce(xp, D):
+    acc = D[:, 0]
+    for i in range(1, D.shape[1]):
+        acc = acc ^ D[:, i]
+    return acc
+
+
+def _horner_reduce(xp, D, k: int):
+    xtime = _xtime_np if xp is np else xtime_device
+    q = D[:, 0]
+    for i in range(1, k):
+        q = xtime(q) ^ D[:, i]
+    return q
+
+
+@functools.cache
+def _make_solve_fn(k: int, case_id: int, ea_inv: int, cb: int,
+                   denom_inv: int):
+    import jax
+
+    @jax.jit
+    def run(D, P, Q):
+        import jax.numpy as jnp
+
+        return _solve_group(jnp, case_id, D, P, Q, ea_inv, cb,
+                            denom_inv, k)
+
+    return run
+
+
+def recover_stripes(stripes: list[tuple[list[np.ndarray | None],
+                                        np.ndarray | None,
+                                        np.ndarray | None]],
+                    device: bool = False
+                    ) -> list[list[np.ndarray]]:
+    """Batched :func:`recover_stripe`: one vectorized GF(256) solve over
+    ALL affected stripes of a read instead of a per-stripe host loop
+    (which measured 1,398 sequential decodes for a 64 MiB degraded read).
+
+    ``stripes`` is a list of (data, p, q) exactly as recover_stripe takes
+    them; every stripe must be within the two-erasure budget (the caller
+    pre-filters, as node.runtime does). Returns the recovered data lists
+    in order. Stripes are grouped by (width, pow2 length bucket) — CDC
+    stripes have near-unique shard lengths, so grouping by EXACT length
+    would degenerate to single-stripe batches; zero-padding to the
+    bucket is GF-exact (parity of zero-padded shards is the zero-padded
+    parity — test_zero_length_and_padding_invariance) and the scatter
+    truncates back. Each group solves in one pass: the uniform heavy
+    math (P ^ xor(data), Q ^ Horner(data)) runs over a [S, k, W] u32
+    stack, and the per-stripe scalar coefficients apply via bit-sliced
+    xtime multiplies. ``device=True`` routes the group solve through the
+    jitted jnp twin of the same code (TPU present); the default NumPy
+    path is the production degraded-read engine."""
+    if not stripes:
+        return []
+
+    results: list[list[np.ndarray] | None] = [None] * len(stripes)
+    groups: dict[tuple[int, int], list[int]] = {}
+    true_len: dict[int, int] = {}
+    for s, (data, p, q) in enumerate(stripes):
+        k = len(data)
+        missing = [i for i, d in enumerate(data) if d is None]
+        lost = len(missing) + (p is None) + (q is None)
+        if lost > 2:
+            raise ValueError(
+                f"stripe {s}: {lost} shards lost, P+Q recovers at most 2")
+        if not missing:
+            results[s] = list(data)  # type: ignore[arg-type]
+            continue
+        if len(missing) == 2 and (p is None or q is None):
+            raise ValueError(
+                f"stripe {s}: two data shards and a parity shard lost")
+        if len(missing) == 1 and p is None and q is None:
+            raise ValueError(f"stripe {s}: data shard and both parities "
+                             "lost")
+        present = [a for a in (*data, p, q) if a is not None]
+        lens = {a.shape[0] for a in present}
+        if len(lens) != 1:
+            raise ValueError(
+                f"stripe {s}: present shards have unequal padded lengths "
+                f"{sorted(lens)}")
+        ln = lens.pop()
+        if ln % 4:
+            raise ValueError(
+                f"stripe {s}: shard length {ln} is not a multiple of 4")
+        true_len[s] = ln
+        # pow2/4 length buckets bound the zero-pad waste to 25%
+        grain = max(4, 1 << max((ln - 1).bit_length() - 2, 2)) if ln else 4
+        bucket = -(-ln // grain) * grain if ln else 4
+        a = missing[0]
+        b = missing[1] if len(missing) == 2 else -1
+        if b >= 0:
+            case = 2
+        elif p is None:
+            case = 1
+        else:
+            case = 0
+        groups.setdefault((k, bucket, case, a, b), []).append(s)
+
+    for (k, bucket, case, a, b), idxs in groups.items():
+        S = len(idxs)
+        W = bucket // 4
+        D = np.zeros((S, k, W), dtype=np.uint32)
+        P = np.zeros((S, W), dtype=np.uint32)
+        Q = np.zeros((S, W), dtype=np.uint32)
+        for r, s in enumerate(idxs):
+            data, p, q = stripes[s]
+            wn = true_len[s] // 4
+            for i, d in enumerate(data):
+                if d is not None:
+                    D[r, i, :wn] = d.view(np.uint32)
+            if case != 1 and p is not None:
+                P[r, :wn] = p.view(np.uint32)
+            if case != 0 and q is not None:
+                Q[r, :wn] = q.view(np.uint32)
+        ca = _q_coeff(a, k)
+        cb = _q_coeff(b, k) if b >= 0 else 0
+        ea_inv = gf_inv(ca)
+        denom_inv = gf_inv(ca ^ cb) if b >= 0 else 0
+
+        if device:
+            import jax
+
+            ra, rb = _make_solve_fn(k, case, ea_inv, cb, denom_inv)(
+                jax.device_put(D), jax.device_put(P), jax.device_put(Q))
+            ra = np.asarray(ra)
+            rb = None if rb is None else np.asarray(rb)
+        else:
+            ra, rb = _solve_group(np, case, D, P, Q, ea_inv, cb,
+                                  denom_inv, k)
+
+        for r, s in enumerate(idxs):
+            data, p, q = stripes[s]
+            ln = true_len[s]
+            out = list(data)
+            out[a] = np.ascontiguousarray(ra[r]).view(np.uint8)[:ln]
+            if b >= 0:
+                out[b] = np.ascontiguousarray(rb[r]).view(np.uint8)[:ln]
+            results[s] = out  # type: ignore[assignment]
+    return results  # type: ignore[return-value]

@@ -19,6 +19,7 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import threading
 import time
 from pathlib import Path
 
@@ -27,9 +28,10 @@ from dfs_tpu.utils.hashing import is_hex_digest
 from dfs_tpu.utils.hashing import sha256_hex
 
 
-def _atomic_write(path: Path, data: bytes) -> None:
-    path.parent.mkdir(parents=True, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=".tmp-")
+def _atomic_write(path: Path | str, data: bytes) -> None:
+    parent = os.path.dirname(os.fspath(path))
+    os.makedirs(parent, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=parent, prefix=".tmp-")
     try:
         with os.fdopen(fd, "wb") as f:
             f.write(data)
@@ -48,39 +50,89 @@ class ChunkStore:
     def __init__(self, root: Path) -> None:
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
+        self._root_str = os.fspath(self.root)
+        self._count: int | None = None     # lazy; maintained by put/delete
+        self._count_lock = threading.Lock()   # puts run in to_thread pools
 
     def _path(self, digest: str) -> Path:
         if not is_hex_digest(digest):
             raise ValueError(f"bad digest {digest!r}")
         return self.root / digest[:2] / digest
 
+    def _path_str(self, digest: str) -> str:
+        # the per-chunk access path: plain string joins — pathlib
+        # construction measured ~1 s of a 3-download profile (one Path
+        # costs ~6 object allocations; reads touch thousands of chunks)
+        if not is_hex_digest(digest):
+            raise ValueError(f"bad digest {digest!r}")
+        return f"{self._root_str}/{digest[:2]}/{digest}"
+
     def has(self, digest: str) -> bool:
-        return self._path(digest).is_file()
+        return os.path.isfile(self._path_str(digest))
 
     def put(self, digest: str, data: bytes, verify: bool = True) -> bool:
         """Store a chunk. Returns False if it already existed (dedup hit).
-        Idempotent and safe under concurrent identical writes."""
-        p = self._path(digest)
-        if p.is_file():
+        Idempotent and safe under concurrent identical writes: the
+        visible write is an os.link of a temp file, which atomically
+        FAILS if the chunk appeared meanwhile — so exactly one of two
+        racing writers observes True and the cached count cannot
+        double-count (content-addressed names make 'it already exists'
+        equivalent to 'it holds the right bytes')."""
+        p = self._path_str(digest)
+        if os.path.isfile(p):
             return False
         if verify and sha256_hex(data) != digest:
             raise ValueError(f"data does not match digest {digest[:12]}…")
-        _atomic_write(p, data)
+        parent = os.path.dirname(p)
+        os.makedirs(parent, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=parent, prefix=".tmp-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            try:
+                os.link(tmp, p)
+            except FileExistsError:
+                return False
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        with self._count_lock:
+            if self._count is not None:
+                self._count += 1
         return True
 
     def get(self, digest: str) -> bytes | None:
-        p = self._path(digest)
         try:
-            return p.read_bytes()
+            with open(self._path_str(digest), "rb") as f:
+                return f.read()
         except FileNotFoundError:
             return None
 
     def delete(self, digest: str) -> bool:
         try:
-            self._path(digest).unlink()
+            os.unlink(self._path_str(digest))
+            with self._count_lock:
+                if self._count is not None:
+                    self._count -= 1
             return True
         except FileNotFoundError:
             return False
+
+    def count(self) -> int:
+        """Number of stored chunks, O(1) after the first call. The full
+        ``digests()`` scan behind the naive count made the internal
+        ``health`` op scale with store size — every peer probes it every
+        few seconds, which measured ~40% of a single-core cluster's read
+        throughput at a 175K-chunk store. Initialized by one scan, then
+        maintained by put/delete (external writes to the directory, or
+        puts racing the very first scan, can skew it by a few until
+        restart — acceptable for a diagnostics field)."""
+        with self._count_lock:
+            if self._count is None:
+                self._count = len(self.digests())
+            return self._count
 
     def digests(self) -> list[str]:
         out = []

@@ -53,11 +53,16 @@ def gear_table(seed: int = 0x9E3779B9) -> np.ndarray:
     return (out & np.uint64(0xFFFFFFFF)).astype(np.uint32)
 
 
+_HEX = frozenset("0123456789abcdef")
+
+
 def is_hex_digest(s: str) -> bool:
     """True iff ``s`` is a 64-char lowercase-hex SHA-256 digest — the only
     legal file/chunk id format (shared by the store and the HTTP layer so
-    the 400 gate and the ValueError gate cannot diverge)."""
-    return len(s) == 64 and all(c in "0123456789abcdef" for c in s)
+    the 400 gate and the ValueError gate cannot diverge). set() over the
+    string keeps the check in C — this gate runs per chunk access and a
+    per-character genexpr measured ~0.5 s per 3 GiB-class degraded read."""
+    return len(s) == 64 and set(s) <= _HEX
 
 
 def next_pow2(x: int) -> int:

@@ -433,8 +433,9 @@ def recover_stripes(stripes: list[tuple[list[np.ndarray | None],
             raise ValueError(
                 f"stripe {s}: shard length {ln} is not a multiple of 4")
         true_len[s] = ln
-        # pow2/4 length buckets bound the zero-pad waste to 25%
-        grain = max(4, 1 << max((ln - 1).bit_length() - 2, 2)) if ln else 4
+        # grain = 1/8 of the length's pow2 ceiling; lengths in an octave
+        # are at least half that ceiling, so zero-pad waste stays < 25%
+        grain = max(4, 1 << max((ln - 1).bit_length() - 3, 2)) if ln else 4
         bucket = -(-ln // grain) * grain if ln else 4
         a = missing[0]
         b = missing[1] if len(missing) == 2 else -1

@@ -128,10 +128,15 @@ class ChunkStore:
         throughput at a 175K-chunk store. Initialized by one scan, then
         maintained by put/delete (external writes to the directory, or
         puts racing the very first scan, can skew it by a few until
-        restart — acceptable for a diagnostics field)."""
+        restart — acceptable for a diagnostics field). The priming scan
+        runs OUTSIDE the lock so a big store's first probe cannot stall
+        concurrent put/delete workers behind it."""
+        if self._count is None:
+            n = len(self.digests())
+            with self._count_lock:
+                if self._count is None:
+                    self._count = n
         with self._count_lock:
-            if self._count is None:
-                self._count = len(self.digests())
             return self._count
 
     def digests(self) -> list[str]:

@@ -39,3 +39,20 @@ def ec_shard_node(file_id: str, stripe: int, shard: int,
         raise ValueError("empty cluster")
     base = (int(file_id[:16], 16) + stripe * 2654435761) % len(node_ids)
     return node_ids[(base + shard) % len(node_ids)]
+
+
+def handoff_order(pinned: list[int], node_ids: list[int]) -> list[int]:
+    """The agreed candidate order for a PINNED (erasure-coded) shard:
+    its pinned holders, then the membership ring cyclically from the
+    first pinned holder. Upload's sloppy-quorum handoff walks exactly
+    this order when a pinned holder is down (node.runtime.store_all), so
+    the READ side must walk the same order — otherwise a handed-off
+    shard is invisible to candidates_for until a repair pass re-homes
+    it, and every read of it pays the batched-round misses plus the
+    cluster-wide has_chunks sweep."""
+    if not pinned:
+        return list(node_ids)
+    start = node_ids.index(pinned[0]) if pinned[0] in node_ids else 0
+    ring = [node_ids[(start + j) % len(node_ids)]
+            for j in range(len(node_ids))]
+    return list(dict.fromkeys(list(pinned) + ring))

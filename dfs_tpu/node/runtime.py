@@ -35,7 +35,8 @@ from dfs_tpu.fragmenter.base import get_fragmenter
 from dfs_tpu.meta.manifest import (ChunkRef, EcInfo, Manifest, StripeRef,
                                    ec_stripe_groups, stripe_shard_len)
 from dfs_tpu.node.health import HealthMonitor
-from dfs_tpu.node.placement import ec_shard_node, replica_set
+from dfs_tpu.node.placement import (ec_shard_node, handoff_order,
+                                    replica_set)
 from dfs_tpu.store.cas import NodeStore
 from dfs_tpu.utils.hashing import (is_hex_digest, sha256_hex,
                                    sha256_many_hex)
@@ -716,9 +717,7 @@ class StorageNodeServer:
             pinned = placement.get(digest)
             if not pinned:
                 return replica_set(digest, ids, len(ids))
-            start = ids.index(pinned[0])
-            ring = [ids[(start + j) % len(ids)] for j in range(len(ids))]
-            return list(dict.fromkeys(pinned + ring))
+            return handoff_order(pinned, ids)
 
         per_node: dict[int, list[tuple[str, bytes]]] = {}
         copies: dict[str, int] = {}
@@ -956,7 +955,14 @@ class StorageNodeServer:
             if manifest is not None and manifest.ec is not None else {}
 
         def candidates_for(d: str) -> list[int]:
-            return pref.get(d) or replica_set(d, ids, rf)
+            pinned = pref.get(d)
+            if pinned:
+                # pinned + the cyclic handoff continuation: a shard that
+                # sloppy-quorum handoff placed on a non-pinned node is
+                # findable by the batched rounds (the write side walked
+                # this same order), not only by the cluster-wide sweep
+                return handoff_order(pinned, ids)
+            return replica_set(d, ids, rf)
 
         def group_remaining(exclude: set[int]) -> dict[int, list[str]]:
             """Missing digests grouped by their first believed-alive

@@ -181,3 +181,49 @@ def test_ec_delete_reclaims_parity(tmp_path, rng):
             await stop_nodes(nodes)
 
     asyncio.run(run())
+
+def test_ec_handoff_shard_readable_without_sweep(tmp_path, rng):
+    """A shard whose pinned holder was down at upload time lands on the
+    next handoff-ring node (sloppy quorum). The read side walks the SAME
+    handoff order (placement.handoff_order), so the batched rounds find
+    it — no cluster-wide has_chunks sweep, no parity decode."""
+    data = rng.integers(0, 256, size=50_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(6)
+        ids = cluster.sorted_ids()
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            # node 2 is down during the EC upload -> its shards hand off
+            await nodes[2].stop()
+            del nodes[2]
+            manifest, stats = await nodes[1].upload(data, "ho.bin",
+                                                    ec_k=3)
+            assert stats["handoffChunks"] > 0, "expected handoff"
+            pl = ec_placement_map(manifest, ids)
+            handed = [d for d, holders in pl.items()
+                      if holders == [2]
+                      and not any(n in nodes and nodes[n].store.chunks
+                                  .has(d) for n in holders)]
+            assert handed, "expected shards pinned to the dead node"
+            # reader that holds nothing locally; count has_chunks sweeps
+            reader = nodes[4]
+            sweeps = 0
+            orig_call = reader.client.call
+
+            async def spy_call(peer, header, **kw):
+                nonlocal sweeps
+                if header.get("op") == "has_chunks":
+                    sweeps += 1
+                return await orig_call(peer, header, **kw)
+
+            reader.client.call = spy_call
+            _, got = await reader.download(manifest.file_id)
+            assert got == data
+            assert sweeps == 0, \
+                "handed-off shards must be found via the handoff ring"
+            assert reader.counters.snapshot().get("ec_decodes", 0) == 0
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())

@@ -228,11 +228,149 @@ def fragmenter_from_description(desc: dict) -> Fragmenter:
     raise ValueError(f"undescribable fragmenter kind {kind!r}")
 
 
+class AutoAnchoredFragmenter(Fragmenter):
+    """kind='auto': the anchored pipeline behind a link-tracking switch.
+
+    The initial probe picks TPU vs CPU engine exactly as before, but the
+    decision is no longer pinned for the process lifetime: this
+    harness's shared tunnel measured ~1.5 GB/s <-> ~10 MB/s hour to
+    hour, so a node that booted in a bad hour would serve CPU-speed
+    forever — and one that booted in a good hour would keep staging into
+    a collapsed link. Data-plane calls re-run the staging probe at most
+    every ``reprobe_s`` seconds, in a daemon thread so no upload ever
+    waits on a probe; engine flips are logged. Delegation is explicit
+    and ``name``/``describe`` come from the ACTIVE engine, so manifests
+    and the resume protocol record the real strategy."""
+
+    def __init__(self, params, probe=None, reprobe_s: float = 300.0):
+        import threading
+        import time as _time
+
+        from dfs_tpu.fragmenter.cdc_anchored import (AnchoredCpuFragmenter,
+                                                     AnchoredTpuFragmenter)
+
+        self._params = params
+        self._cls = {True: AnchoredTpuFragmenter,
+                     False: AnchoredCpuFragmenter}
+        self._instances: dict[bool, Fragmenter] = {}
+        self._probe = probe if probe is not None else tpu_available
+        self._reprobe_s = reprobe_s
+        self._lock = threading.Lock()
+        self._probing = False
+        self._clock = _time.monotonic
+        self._engine = self._instance(bool(self._probe()))
+        self._last_probe = self._clock()
+
+    def _instance(self, use_tpu: bool) -> Fragmenter:
+        # engines are built at most once: a flip back to TPU must not
+        # discard the staging-buffer pool whose whole purpose is
+        # amortizing the one-time host->device transfer setup
+        if use_tpu not in self._instances:
+            self._instances[use_tpu] = self._cls[use_tpu](self._params)
+        return self._instances[use_tpu]
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        return self._engine.name
+
+    @property
+    def params(self):
+        return self._params
+
+    @property
+    def engine(self) -> Fragmenter:
+        return self._engine
+
+    def reprobe_now(self) -> None:
+        """Synchronous re-probe + possible engine flip (the background
+        path calls this from a daemon thread; tests call it directly)."""
+        import logging
+
+        use_tpu = bool(self._probe())
+        with self._lock:
+            self._last_probe = self._clock()
+            if use_tpu != isinstance(self._engine, self._cls[True]):
+                old = self._engine.name
+                self._engine = self._instance(use_tpu)
+                logging.getLogger("dfs_tpu.fragmenter").warning(
+                    "auto engine flip: %s -> %s (staging link re-probe)",
+                    old, self._engine.name)
+
+    def _maybe_reprobe(self) -> None:
+        import threading
+
+        with self._lock:
+            if (self._probing
+                    or self._clock() - self._last_probe < self._reprobe_s):
+                return
+            self._probing = True
+
+        def run() -> None:
+            try:
+                self.reprobe_now()
+            finally:
+                with self._lock:
+                    self._probing = False
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def chunk(self, data: bytes):
+        self._maybe_reprobe()
+        return self._engine.chunk(data)
+
+    def manifest(self, data: bytes, name: str, file_id: str | None = None):
+        self._maybe_reprobe()
+        return self._engine.manifest(data, name, file_id=file_id)
+
+    def manifest_stream(self, blocks, name: str, store=None):
+        self._maybe_reprobe()
+        return self._engine.manifest_stream(blocks, name, store=store)
+
+    def chunks_stream(self, blocks, store=None):
+        self._maybe_reprobe()
+        return self._engine.chunks_stream(blocks, store=store)
+
+    def stream_span(self):
+        # the WORST (largest) bound of both engines, not the active
+        # one's: a client that sized its tee buffer from the smaller CPU
+        # bound would deadlock after a background flip to the TPU engine
+        # mid-stream. Both bounds derive from the shared params, so this
+        # is stable across flips.
+        spans = [self._instance(False).stream_span(),
+                 self._instance(True).stream_span()]
+        if any(s is None for s in spans):
+            return None
+        return max(spans)
+
+    def describe(self) -> dict:
+        return self._engine.describe()
+
+
+def _anchored_params(cdc_params):
+    from dfs_tpu.ops.cdc_anchored import TILE_BYTES, AnchoredCdcParams
+
+    if isinstance(cdc_params, AnchoredCdcParams):
+        return cdc_params
+    if cdc_params is not None:
+        # operator chunk sizing (NodeConfig.cdc is always a CDCParams)
+        # must reach the nested aligned grid — the segment level scales
+        # with it: seg_max is pinned to one lane (strip bytes) and
+        # seg_min keeps the default 3:4 ratio, tile-aligned.
+        chunk = _aligned_from_cdc(cdc_params)
+        seg_max = chunk.strip_blocks * 64
+        seg_min = max(TILE_BYTES,
+                      (3 * seg_max // 4) // TILE_BYTES * TILE_BYTES)
+        return AnchoredCdcParams(chunk=chunk, seg_min=seg_min,
+                                 seg_max=seg_max)
+    return AnchoredCdcParams()
+
+
 def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5) -> Fragmenter:
     """Factory keyed by NodeConfig.fragmenter. ``"auto"`` (the serve
     default) resolves to the flagship anchored pipeline: the TPU device
     path when a TPU is present, its CPU oracle otherwise — a default
-    deployment on accelerated hardware must actually use the accelerator."""
+    deployment on accelerated hardware must actually use the accelerator
+    — re-probing the staging link periodically (AutoAnchoredFragmenter)."""
     import warnings
 
     from dfs_tpu.config import CDCParams
@@ -241,29 +379,14 @@ def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5) -> Fragm
     from dfs_tpu.fragmenter.fixed import FixedFragmenter
 
     if kind == "auto":
-        kind = "cdc-anchored-tpu" if tpu_available() else "cdc-anchored"
+        return AutoAnchoredFragmenter(_anchored_params(cdc_params))
     if kind == "fixed":
         return FixedFragmenter(parts=fixed_parts)
     if kind in ("cdc-anchored", "cdc-anchored-tpu"):
         from dfs_tpu.fragmenter.cdc_anchored import (AnchoredCpuFragmenter,
                                                      AnchoredTpuFragmenter)
-        from dfs_tpu.ops.cdc_anchored import TILE_BYTES, AnchoredCdcParams
 
-        if isinstance(cdc_params, AnchoredCdcParams):
-            params = cdc_params
-        elif cdc_params is not None:
-            # operator chunk sizing (NodeConfig.cdc is always a CDCParams)
-            # must reach the nested aligned grid — the segment level scales
-            # with it: seg_max is pinned to one lane (strip bytes) and
-            # seg_min keeps the default 3:4 ratio, tile-aligned.
-            chunk = _aligned_from_cdc(cdc_params)
-            seg_max = chunk.strip_blocks * 64
-            seg_min = max(TILE_BYTES,
-                          (3 * seg_max // 4) // TILE_BYTES * TILE_BYTES)
-            params = AnchoredCdcParams(chunk=chunk, seg_min=seg_min,
-                                       seg_max=seg_max)
-        else:
-            params = AnchoredCdcParams()
+        params = _anchored_params(cdc_params)
         cls = AnchoredCpuFragmenter if kind == "cdc-anchored" \
             else AnchoredTpuFragmenter
         return cls(params)

@@ -32,6 +32,9 @@ _REASONS = {200: "OK", 201: "Created", 206: "Partial Content",
             413: "Payload Too Large",
             416: "Range Not Satisfiable", 500: "Internal Server Error"}
 MAX_BODY = 4 * 1024 * 1024 * 1024
+# plain (Content-Length) uploads above this stream through the
+# bounded-memory ingest instead of materializing the body in node RAM
+STREAM_BODY_BYTES = 64 * 1024 * 1024
 
 
 def _resp(status: int, body: bytes, content_type: str,
@@ -314,33 +317,44 @@ async def _serve_one(node: "StorageNodeServer",
             if chunked:
                 return plain(400, "ec requires a whole-body upload "
                                   "(parity stripes span chunk groups)")
-        if chunked:
-            # streaming ingest: the chunked-transfer body feeds the
-            # fragmenter's bounded-memory pipeline as it arrives — the
-            # whole payload never exists in node memory (the reference
-            # reads the entire body into one array, StorageNode.java:124)
+        if not chunked:
+            if content_length is None:
+                return plain(411, "Length Required")  # reference parity
+            if content_length > MAX_BODY:
+                return plain(413, "Payload Too Large")
+        if chunked or (content_length > STREAM_BODY_BYTES and not ec_k):
+            # streaming ingest: the body feeds the fragmenter's
+            # bounded-memory pipeline as it arrives — the whole payload
+            # never exists in node memory (the reference reads the
+            # entire body into one array, StorageNode.java:124). Since
+            # round 4 large PLAIN bodies take this path too, read off
+            # the socket in ~1 MiB pieces; EC uploads still materialize
+            # (parity stripes group chunks across the whole file).
+            async def _plain_body():
+                left = content_length
+                while left:
+                    b = await reader.read(min(1 << 20, left))
+                    if not b:
+                        raise asyncio.IncompleteReadError(b"", left)
+                    left -= len(b)
+                    yield b
+
+            body = _chunked_body(reader) if chunked else _plain_body()
             try:
                 manifest, stats = await node.upload_stream(
-                    _chunked_body(reader), query.get("name", ""))
+                    body, query.get("name", ""))
             except UploadError as e:
                 return plain(500, str(e))
             except ValueError as e:
                 return plain(400, f"Bad chunked body: {e}")
-            return as_json(201, {"fileId": manifest.file_id,
-                                 "name": manifest.name,
-                                 "size": manifest.size,
-                                 "chunks": manifest.total_chunks, **stats})
-        if content_length is None:
-            return plain(411, "Length Required")  # reference parity
-        if content_length > MAX_BODY:
-            return plain(413, "Payload Too Large")
-        data = await reader.readexactly(content_length)
-        try:
-            manifest, stats = await node.upload(data, query.get("name", ""),
-                                                ec_k=ec_k)
-        except UploadError as e:
-            # "Replication failed" -> 500 (:176); ec validation -> 400
-            return plain(getattr(e, "status", 500), str(e))
+        else:
+            data = await reader.readexactly(content_length)
+            try:
+                manifest, stats = await node.upload(
+                    data, query.get("name", ""), ec_k=ec_k)
+            except UploadError as e:
+                # "Replication failed" -> 500 (:176); ec validation -> 400
+                return plain(getattr(e, "status", 500), str(e))
         return as_json(201, {"fileId": manifest.file_id,
                              "name": manifest.name,
                              "size": manifest.size,

@@ -209,6 +209,10 @@ async def _serve_one(node: "StorageNodeServer",
                     content_length = int(v.strip())
                 except ValueError:
                     return plain(400, "Bad Content-Length")
+                if content_length < 0:
+                    # int() accepts signs; a negative length would reach
+                    # readexactly() and 500 instead of being rejected
+                    return plain(400, "Bad Content-Length")
             elif key == "range":
                 range_header = v.strip()
             elif key == "transfer-encoding":

@@ -25,22 +25,25 @@ _lib: ctypes.CDLL | None = None
 _tried = False
 
 
-def _build() -> bool:
+def _compile(args: list[str], tmp: Path, dst: Path) -> bool:
     # compile to a temp path and rename over the target: rebuilding in
     # place would truncate an inode this (or another) process may have
     # dlopen'd/mmapped — SIGBUS territory; rename swaps a fresh inode in
     # atomically for concurrent loaders too
-    tmp = _SO.with_suffix(f".tmp{os.getpid()}.so")
     try:
-        subprocess.run(
-            ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-             str(_SRC), "-o", str(tmp)],
-            check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)
+        subprocess.run(args, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, dst)
         return True
     except (OSError, subprocess.SubprocessError):
         tmp.unlink(missing_ok=True)
         return False
+
+
+def _build() -> bool:
+    tmp = _SO.with_suffix(f".tmp{os.getpid()}.so")
+    return _compile(
+        ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+         str(_SRC), "-o", str(tmp)], tmp, _SO)
 
 
 def get_lib() -> ctypes.CDLL | None:
@@ -207,3 +210,24 @@ def native_gear_cuts(data: bytes | np.ndarray, table: np.ndarray, mask: int,
     if wrote < 0:
         return None
     return cuts[:wrote].astype(np.int64)
+
+
+_SIDECAR_SRC = _DIR / "sidecar_client.cpp"
+_SIDECAR_BIN = _DIR / "sidecar_client"
+
+
+def build_sidecar_client() -> Path | None:
+    """Build (once, cached) the dependency-free C++ sidecar conformance
+    client — POSIX sockets + hand-rolled HTTP/2, no gRPC library (see
+    sidecar_client.cpp and docs/sidecar_wire.md). Returns the binary
+    path, or None when the toolchain is unavailable."""
+    if not _SIDECAR_SRC.is_file():
+        return _SIDECAR_BIN if _SIDECAR_BIN.is_file() else None
+    if _SIDECAR_BIN.is_file() \
+            and _SIDECAR_BIN.stat().st_mtime >= _SIDECAR_SRC.stat().st_mtime:
+        return _SIDECAR_BIN
+    tmp = _SIDECAR_BIN.with_suffix(f".tmp{os.getpid()}")
+    if _compile(["g++", "-O2", "-o", str(tmp), str(_SIDECAR_SRC)],
+                tmp, _SIDECAR_BIN):
+        return _SIDECAR_BIN
+    return None

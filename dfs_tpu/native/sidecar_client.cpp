@@ -1,0 +1,314 @@
+// sidecar_client — a NON-PYTHON client for the dfs.Sidecar gRPC service.
+//
+// Proves the sidecar's host boundary is language-neutral (BASELINE.json
+// north star: "the Java StorageNode calls the TPU backend over a local
+// gRPC sidecar"): this program speaks the documented wire contract
+// (docs/sidecar_wire.md) with NOTHING but POSIX sockets — no gRPC
+// library, no HTTP/2 library, no protobuf. It is both the conformance
+// client CI runs against a live sidecar (tests/test_sidecar_wire.py)
+// and the reference implementation a foreign host can crib from.
+//
+//   usage: sidecar_client <host> <port> <file>
+//
+// Streams <file> into /dfs.Sidecar/ChunkHashStream as gRPC
+// length-prefixed messages over an HTTP/2 cleartext (h2c,
+// prior-knowledge) connection and prints the JSON chunk table the
+// service returns to stdout. Exit 0 on a complete response stream.
+//
+// HTTP/2 subset implemented (RFC 9113): connection preface, SETTINGS
+// exchange + ack, HEADERS with a static-table-only HPACK encoding (no
+// dynamic table, no Huffman — always legal for a sender), DATA with
+// both flow-control windows respected, WINDOW_UPDATE both directions,
+// PING ack, padded/priority flag handling on receive. Response header
+// blocks are not HPACK-decoded — the conformance signal is the chunk
+// table itself, which the test checks byte-for-byte against the CPU
+// oracle fragmenter.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+[[noreturn]] void die(const std::string& m) {
+  std::fprintf(stderr, "sidecar_client: %s\n", m.c_str());
+  std::exit(2);
+}
+
+void write_all(int fd, const char* p, size_t n) {
+  while (n) {
+    ssize_t w = ::send(fd, p, n, 0);
+    if (w <= 0) die("send failed");
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+}
+
+void read_exact(int fd, char* p, size_t n) {
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) die("recv failed (connection closed or timed out)");
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+}
+
+std::string frame(uint8_t type, uint8_t flags, uint32_t stream,
+                  const std::string& payload) {
+  std::string f;
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  f.push_back(static_cast<char>((len >> 16) & 0xFF));
+  f.push_back(static_cast<char>((len >> 8) & 0xFF));
+  f.push_back(static_cast<char>(len & 0xFF));
+  f.push_back(static_cast<char>(type));
+  f.push_back(static_cast<char>(flags));
+  f.push_back(static_cast<char>((stream >> 24) & 0x7F));
+  f.push_back(static_cast<char>((stream >> 16) & 0xFF));
+  f.push_back(static_cast<char>((stream >> 8) & 0xFF));
+  f.push_back(static_cast<char>(stream & 0xFF));
+  f += payload;
+  return f;
+}
+
+constexpr uint8_t kData = 0x0, kHeaders = 0x1, kRstStream = 0x3,
+                  kSettings = 0x4, kPing = 0x6, kGoaway = 0x7,
+                  kWindowUpdate = 0x8;
+constexpr uint8_t kEndStream = 0x1, kAck = 0x1, kEndHeaders = 0x4,
+                  kPadded = 0x8, kPriority = 0x20;
+
+struct Conn {
+  int fd = -1;
+  int64_t conn_window = 65535;    // our send budget, connection-level
+  int64_t stream_window = 65535;  // our send budget, stream 1
+  int32_t peer_initial_window = 65535;
+  uint32_t max_frame = 16384;
+  std::string response;  // stream-1 DATA bytes (the gRPC response)
+  bool done = false;     // END_STREAM seen on stream 1
+
+  // Read and handle exactly one frame from the server.
+  void pump() {
+    char h[9];
+    read_exact(fd, h, 9);
+    uint32_t len = (static_cast<uint8_t>(h[0]) << 16) |
+                   (static_cast<uint8_t>(h[1]) << 8) |
+                   static_cast<uint8_t>(h[2]);
+    uint8_t type = static_cast<uint8_t>(h[3]);
+    uint8_t flags = static_cast<uint8_t>(h[4]);
+    uint32_t stream = ((static_cast<uint8_t>(h[5]) & 0x7F) << 24) |
+                      (static_cast<uint8_t>(h[6]) << 16) |
+                      (static_cast<uint8_t>(h[7]) << 8) |
+                      static_cast<uint8_t>(h[8]);
+    std::vector<char> buf(len);
+    if (len) read_exact(fd, buf.data(), len);
+
+    switch (type) {
+      case kSettings: {
+        if (flags & kAck) break;
+        for (uint32_t off = 0; off + 6 <= len; off += 6) {
+          uint16_t id = (static_cast<uint8_t>(buf[off]) << 8) |
+                        static_cast<uint8_t>(buf[off + 1]);
+          uint32_t val = (static_cast<uint8_t>(buf[off + 2]) << 24) |
+                         (static_cast<uint8_t>(buf[off + 3]) << 16) |
+                         (static_cast<uint8_t>(buf[off + 4]) << 8) |
+                         static_cast<uint8_t>(buf[off + 5]);
+          if (id == 0x4) {  // INITIAL_WINDOW_SIZE: retro-adjusts streams
+            stream_window += static_cast<int64_t>(val) - peer_initial_window;
+            peer_initial_window = static_cast<int32_t>(val);
+          } else if (id == 0x5) {  // MAX_FRAME_SIZE
+            max_frame = val;
+          }
+        }
+        std::string ack = frame(kSettings, kAck, 0, "");
+        write_all(fd, ack.data(), ack.size());
+        break;
+      }
+      case kWindowUpdate: {
+        if (len != 4) die("bad WINDOW_UPDATE");
+        uint32_t inc = ((static_cast<uint8_t>(buf[0]) & 0x7F) << 24) |
+                       (static_cast<uint8_t>(buf[1]) << 16) |
+                       (static_cast<uint8_t>(buf[2]) << 8) |
+                       static_cast<uint8_t>(buf[3]);
+        (stream == 0 ? conn_window : stream_window) += inc;
+        break;
+      }
+      case kPing: {
+        if (!(flags & kAck)) {
+          std::string pong =
+              frame(kPing, kAck, 0, std::string(buf.data(), len));
+          write_all(fd, pong.data(), pong.size());
+        }
+        break;
+      }
+      case kData: {
+        if (stream != 1) break;
+        size_t begin = 0, end = len;
+        if (flags & kPadded) {
+          if (len == 0) die("padded DATA frame with no pad length");
+          uint8_t pad = static_cast<uint8_t>(buf[0]);
+          if (static_cast<size_t>(pad) + 1 > len)
+            die("DATA pad length exceeds frame");
+          begin = 1;
+          end = len - pad;
+        }
+        response.append(buf.data() + begin, end - begin);
+        if (len) {  // hand the server its receive window back
+          std::string inc;
+          for (char c : {0, 0, 0, 0}) inc.push_back(c);
+          inc[0] = static_cast<char>((len >> 24) & 0x7F);
+          inc[1] = static_cast<char>((len >> 16) & 0xFF);
+          inc[2] = static_cast<char>((len >> 8) & 0xFF);
+          inc[3] = static_cast<char>(len & 0xFF);
+          std::string w0 = frame(kWindowUpdate, 0, 0, inc);
+          std::string w1 = frame(kWindowUpdate, 0, 1, inc);
+          write_all(fd, w0.data(), w0.size());
+          write_all(fd, w1.data(), w1.size());
+        }
+        if (flags & kEndStream) done = true;
+        break;
+      }
+      case kHeaders: {  // response headers / trailers; block not decoded
+        if (stream == 1 && (flags & kEndStream)) done = true;
+        break;
+      }
+      case kRstStream:
+        die("server reset the stream");
+      case kGoaway: {
+        if (!done) die("server GOAWAY before response completed");
+        break;
+      }
+      default:
+        break;  // PUSH_PROMISE/CONTINUATION/unknown: ignore
+    }
+  }
+
+  void send_flow_controlled(const char* p, size_t n, bool end_stream) {
+    while (n) {
+      size_t take = n;
+      if (take > max_frame) take = max_frame;
+      while (conn_window < static_cast<int64_t>(take) ||
+             stream_window < static_cast<int64_t>(take)) {
+        pump();  // wait for WINDOW_UPDATE / process SETTINGS / PING
+      }
+      bool last = (take == n) && end_stream;
+      std::string f = frame(kData, last ? kEndStream : 0, 1,
+                            std::string(p, take));
+      write_all(fd, f.data(), f.size());
+      conn_window -= static_cast<int64_t>(take);
+      stream_window -= static_cast<int64_t>(take);
+      p += take;
+      n -= take;
+    }
+  }
+};
+
+// HPACK, encoder side only: static-table indexed fields plus
+// literal-without-indexing — never requires a dynamic table or Huffman.
+std::string hpack_request_headers(const std::string& authority,
+                                  const std::string& path) {
+  std::string hb;
+  hb.push_back('\x83');  // :method: POST   (static table index 3)
+  hb.push_back('\x86');  // :scheme: http   (static table index 6)
+  auto literal = [&hb](int name_index, const std::string& value) {
+    // literal field without indexing, 4-bit prefixed name index
+    if (name_index < 15) {
+      hb.push_back(static_cast<char>(name_index));
+    } else {
+      hb.push_back('\x0F');
+      hb.push_back(static_cast<char>(name_index - 15));
+    }
+    if (value.size() > 126) die("header value too long for this encoder");
+    hb.push_back(static_cast<char>(value.size()));  // Huffman bit clear
+    hb += value;
+  };
+  literal(4, path);                    // :path
+  literal(1, authority);               // :authority
+  literal(31, "application/grpc");     // content-type
+  // te: trailers — name not in the static table: literal new name
+  hb.push_back('\x00');
+  hb.push_back('\x02');
+  hb += "te";
+  hb.push_back('\x08');
+  hb += "trailers";
+  return hb;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    std::fprintf(stderr, "usage: %s <host> <port> <file>\n", argv[0]);
+    return 2;
+  }
+  const std::string host = argv[1], port = argv[2], path = argv[3];
+
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0 || !res)
+    die("getaddrinfo failed");
+  Conn c;
+  c.fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (c.fd < 0 || ::connect(c.fd, res->ai_addr, res->ai_addrlen) != 0)
+    die("connect failed");
+  freeaddrinfo(res);
+  timeval tv{60, 0};
+  setsockopt(c.fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) die("cannot open " + path);
+
+  // connection preface + our (empty = all defaults) SETTINGS
+  static const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+  write_all(c.fd, kPreface, sizeof(kPreface) - 1);
+  std::string s = frame(kSettings, 0, 0, "");
+  write_all(c.fd, s.data(), s.size());
+
+  std::string hb = hpack_request_headers(
+      host + ":" + port, "/dfs.Sidecar/ChunkHashStream");
+  std::string hf = frame(kHeaders, kEndHeaders, 1, hb);
+  write_all(c.fd, hf.data(), hf.size());
+
+  // stream the file as gRPC length-prefixed messages:
+  // [1-byte compressed flag = 0][4-byte big-endian length][payload]
+  std::vector<char> block(64 * 1024);
+  std::string msg;
+  for (;;) {
+    size_t n = std::fread(block.data(), 1, block.size(), f);
+    if (n == 0) break;
+    msg.clear();
+    msg.push_back('\0');
+    msg.push_back(static_cast<char>((n >> 24) & 0xFF));
+    msg.push_back(static_cast<char>((n >> 16) & 0xFF));
+    msg.push_back(static_cast<char>((n >> 8) & 0xFF));
+    msg.push_back(static_cast<char>(n & 0xFF));
+    msg.append(block.data(), n);
+    c.send_flow_controlled(msg.data(), msg.size(), false);
+  }
+  std::fclose(f);
+  std::string fin = frame(kData, kEndStream, 1, "");  // half-close
+  write_all(c.fd, fin.data(), fin.size());
+
+  while (!c.done) c.pump();
+
+  if (c.response.size() < 5) die("no gRPC response message");
+  if (c.response[0] != 0) die("compressed response unsupported");
+  uint32_t mlen = (static_cast<uint8_t>(c.response[1]) << 24) |
+                  (static_cast<uint8_t>(c.response[2]) << 16) |
+                  (static_cast<uint8_t>(c.response[3]) << 8) |
+                  static_cast<uint8_t>(c.response[4]);
+  if (c.response.size() < 5 + static_cast<size_t>(mlen))
+    die("truncated gRPC response message");
+  std::fwrite(c.response.data() + 5, 1, mlen, stdout);
+  std::fputc('\n', stdout);
+  ::close(c.fd);
+  return 0;
+}

@@ -1043,9 +1043,15 @@ def test_plain_content_length_upload_is_bounded_memory(tmp_path, rng):
             client = NodeClient(port=cluster.peer(1).port,
                                 timeout_s=600.0)
             import hashlib
-            fid = hashlib.sha256(block * body_blocks).hexdigest()
-            got = await asyncio.to_thread(client.download, fid)
-            assert got == block * body_blocks
+            h = hashlib.sha256()
+            for _ in range(body_blocks):     # incremental: the test's
+                h.update(block)              # own footprint stays small
+            got = await asyncio.to_thread(client.download, h.hexdigest())
+            assert len(got) == total
+            view = memoryview(got)
+            for i in range(body_blocks):
+                assert view[i * len(block):(i + 1) * len(block)] == block
+            del view, got
         finally:
             StorageNodeServer.upload = orig_upload
             await stop_nodes(nodes)

@@ -23,9 +23,13 @@ is gone):
   buffer in HBM, min(difference-of-mins, paired-slope-median) over
   adjacent k=10/k=40 chain-timing pairs spread across ~2.5 minutes of
   the shared chip's contention plateaus (raw samples embedded in the
-  JSON), i.e. the kernel capability that an overlapped ingest path
-  (double-buffered device_put, fragmenter/cdc_anchored.py) converges to
-  on real PCIe/DMA links.
+  JSON). Scope: this is the KERNEL capability. The overlapped ingest
+  path (double-buffered device_put, fragmenter/cdc_anchored.py) can in
+  principle converge to it when staging outruns the chain (>= ~8 GB/s
+  for a 64 MiB/8 ms region), but this harness's tunnel has never
+  offered that (measured 10-1500 MB/s), so end-to-end convergence is
+  untested — the recorded end-to-end numbers are the CPU engine's
+  (E2E artifacts, bench_e2e_stream.py).
 - stderr: warm end-to-end (staging + compute, compile excluded) — the
   harness's SHARED device tunnel swings from ~1.5 GB/s to ~10 MB/s hour
   to hour (measured round 3), so this number tracks link contention, not

@@ -60,14 +60,49 @@ def _probe_free(port: int) -> bool:
         s.close()
 
 
+def _png(width: int = 64, height: int = 64) -> bytes:
+    """A REAL (decodable) PNG: 8-bit grayscale gradient, zlib-compressed
+    scanlines, correct chunk CRCs — same content class as the
+    reference's pl.png, built here instead of copied."""
+    import struct
+    import zlib
+
+    def chunk(tag: bytes, body: bytes) -> bytes:
+        return (struct.pack(">I", len(body)) + tag + body
+                + struct.pack(">I", zlib.crc32(tag + body)))
+
+    ihdr = struct.pack(">IIBBBBB", width, height, 8, 0, 0, 0, 0)
+    raw = b"".join(
+        b"\x00" + bytes((x * 7 + y * 13) & 0xFF for x in range(width))
+        for y in range(height))
+    return (b"\x89PNG\r\n\x1a\n" + chunk(b"IHDR", ihdr)
+            + chunk(b"IDAT", zlib.compress(raw, 6)) + chunk(b"IEND", b""))
+
+
+def _jpeg(rng, entropy_bytes: int = 9000) -> bytes:
+    """A JPEG-marker-FRAMED payload (SOI / APP0-JFIF / 0xFF-stuffed
+    entropy bytes / EOI) — the id.jpg analogue. NOT a decodable image
+    (no DQT/DHT/SOF/SOS segments): the storage path never decodes, it
+    round-trips high-entropy image-format-shaped bytes."""
+    body = rng.integers(0, 256, size=entropy_bytes,
+                        dtype=np.uint8).tobytes()
+    stuffed = body.replace(b"\xff", b"\xff\x00")
+    app0 = b"\xff\xe0\x00\x10JFIF\x00\x01\x02\x00\x00\x01\x00\x01\x00\x00"
+    return b"\xff\xd8" + app0 + stuffed + b"\xff\xd9"
+
+
 def _fixtures(rng) -> dict[str, bytes]:
-    """Analogues of the reference's examples/ (teste.txt, pag1.html,
-    id.jpg, pl.png): small text, HTML, and two binary payloads."""
+    """Analogues of the reference's examples/ corpus (teste.txt,
+    pag1.html, id.jpg, pl.png — the de-facto test set of
+    /root/reference/README.md:172-179): small text, HTML, a real PNG,
+    and a marker-correct JPEG payload."""
     return {
         "teste.txt": b"esta e uma mensagem de teste\n",
-        "pag1.html": b"<html><body><h1>pagina 1</h1></body></html>\n",
-        "id.jpg": rng.integers(0, 256, size=9506, dtype=np.uint8).tobytes(),
-        "pl.png": rng.integers(0, 256, size=2154, dtype=np.uint8).tobytes(),
+        "pag1.html": (b"<html><head><title>pagina 1</title></head>"
+                      b"<body><h1>pagina 1</h1><p>conteudo de teste"
+                      b"</p></body></html>\n"),
+        "id.jpg": _jpeg(rng),
+        "pl.png": _png(),
     }
 
 

@@ -46,9 +46,13 @@ def main() -> int:
     m_words = A.recover_m_words(int(words.shape[0]), params)
     m_tiles = m_words * 4 // A.TILE_BYTES
     cap = m_words * 4 // params.seg_min + 1
-    s_pad = -(-cap // 128) * 128
+    # stage sizing must match the PRODUCTION chain (cap_mode='tight'):
+    # the lane tables are tight-provisioned while the select scan runs
+    # at the full bound — otherwise the stage rows would overshoot the
+    # 'full chain' row by exactly the padding-lane cost
+    s_pad = A._tight_segment_lanes(params, m_words, 128)
     print(f"region={region / 2**20:.0f} MiB m_words={m_words} cap={cap} "
-          f"s_pad={s_pad}", file=sys.stderr)
+          f"s_pad={s_pad} (tight lanes)", file=sys.stderr)
 
     anchor = A.make_anchor_fn(params, m_words)
     select = A.make_select_fn(params, m_tiles, cap)
@@ -62,7 +66,8 @@ def main() -> int:
     tiles = anchor(words)
     bounds = select(tiles, z, n, fin)
     d = desc(bounds, z)
-    starts, seg_lens, w_off, sh8, real_blocks, tail_len, consumed = d
+    (starts, seg_lens, w_off, sh8, real_blocks, tail_len, consumed,
+     nseg) = d
     jax.block_until_ready(d)
     scan_half, compact_half = seg.halves
     sh_out = jax.block_until_ready(

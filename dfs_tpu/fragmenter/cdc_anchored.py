@@ -274,10 +274,13 @@ class AnchoredTpuFragmenter(_AnchoredBase):
         try:
             spans, consumed = region_collect(out)
         except CutCapacityOverflow:
-            # this window's content out-chunked the tight cut capacity —
-            # redo it alone at the worst-case bound. The device carry
-            # (consumed) that later windows chained on is capacity-
-            # independent, so the rest of the pipeline stays valid.
+            # this window's content out-chunked the tight provisioning
+            # (cut capacity or segment lanes) — redo it alone at the
+            # worst-case bound. The device carry (consumed) that later
+            # windows chained on is capacity-independent BY CONSTRUCTION
+            # (the select scan always runs at the full bound and
+            # consumed comes from the full boundary list, ops
+            # make_chain_fn), so the rest of the pipeline stays valid.
             lookback = np.zeros((8,), np.uint8)
             take = min(8, base)
             if take:

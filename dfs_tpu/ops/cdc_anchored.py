@@ -368,7 +368,9 @@ def make_select_fn(params: AnchoredCdcParams, m_tiles: int, cap: int):
 def make_descriptor_fn(params: AnchoredCdcParams, cap: int, s_pad: int):
     """Compiled: (bounds [cap] i32 — select output, start0 i32) ->
     (starts [s_pad], seg_lens [s_pad], w_off [s_pad], sh8 [s_pad] u32,
-     real_blocks [s_pad], tail_len [s_pad], consumed i32).
+     real_blocks [s_pad], tail_len [s_pad], consumed i32, nseg i32).
+    ``consumed``/``nseg`` cover the FULL boundary list; the [s_pad]
+    lane tables may truncate it under tight provisioning (s_pad < cap).
 
     Everything pass B needs, derived on device — the round-1 design pulled
     ``bounds`` to the host to build these arrays, which put a tunnel/PCIe
@@ -385,17 +387,26 @@ def make_descriptor_fn(params: AnchoredCdcParams, cap: int, s_pad: int):
             [start0[None].astype(jnp.int32), bounds[:-1]])
         starts = jnp.where(valid, starts, 0)
         seg_lens = jnp.where(valid, bounds - starts, 0)
-        pad = s_pad - cap
-        starts_p = jnp.pad(starts, (0, pad))
-        seg_lens_p = jnp.pad(seg_lens, (0, pad))
+        # consumed and nseg come from the FULL bounds list BEFORE the
+        # lane tables truncate to s_pad: the walk chains its device
+        # carry on consumed, so it must stay capacity-independent even
+        # when tight lane provisioning (s_pad < cap) drops the table's
+        # tail — the overflow redo then only ever repairs ONE window
+        consumed = jnp.max(jnp.where(valid, bounds,
+                                     start0.astype(jnp.int32)))
+        nseg = jnp.sum(valid.astype(jnp.int32))
+        if s_pad >= cap:
+            starts_p = jnp.pad(starts, (0, s_pad - cap))
+            seg_lens_p = jnp.pad(seg_lens, (0, s_pad - cap))
+        else:
+            starts_p = starts[:s_pad]
+            seg_lens_p = seg_lens[:s_pad]
         w_off = starts_p // jnp.int32(4) + jnp.int32(2)
         sh8 = ((starts_p % jnp.int32(4)) * jnp.int32(8)).astype(jnp.uint32)
         real_blocks = (seg_lens_p + jnp.int32(BLOCK - 1)) // jnp.int32(BLOCK)
         tail_len = seg_lens_p % jnp.int32(BLOCK)
-        consumed = jnp.max(jnp.where(valid, bounds,
-                                     start0.astype(jnp.int32)))
         return (starts_p, seg_lens_p, w_off, sh8, real_blocks, tail_len,
-                consumed)
+                consumed, nseg)
 
     return run
 
@@ -405,7 +416,29 @@ def make_descriptor_fn(params: AnchoredCdcParams, cap: int, s_pad: int):
 # ---------------------------------------------------------------------------
 
 class CutCapacityOverflow(RuntimeError):
-    """More cuts than the tight capacity — caller retries at full bound."""
+    """More cuts (or segments) than the tight provisioning — the caller
+    retries the window at the full worst-case bound."""
+
+
+def _tight_segment_lanes(params: AnchoredCdcParams, m_words: int,
+                         lane_multiple: int) -> int:
+    """Lane count for cap_mode='tight': ~1.1x the EXPECTED segment
+    count, rounded up to the compaction tiling. The worst case (every
+    boundary at seg_min) provisions ~25% more lanes than real content
+    ever uses, and padding lanes are not free — repack writes them, the
+    transpose moves them, and the strip-scan SHA kernel computes over
+    them masked (measured ~17% of the scan half at default params).
+    Expected segment length = seg_max minus one mean anchor gap (the
+    boundary is the LAST anchor in the window, Exp(gap)-truncated below
+    it). Content denser in segments than the margin trips the exact
+    on-device segment count (nseg > lanes, counted by the full-bound
+    select scan) and redispatches at 'full' — same contract as the cut
+    capacity, and the carry stays exact throughout (make_chain_fn)."""
+    full = m_words * 4 // params.seg_min + 1
+    avg_seg = max(params.seg_min, params.seg_max - (params.seg_mask + 1))
+    expected = max(1, m_words * 4 // avg_seg)
+    tight = -(-(expected * 11 // 10) // lane_multiple) * lane_multiple
+    return min(tight, -(-full // lane_multiple) * lane_multiple)
 
 
 @functools.cache
@@ -624,13 +657,32 @@ def make_chain_fn(params: AnchoredCdcParams, total_words: int,
     stage jits inline into this trace, so a region costs ONE dispatch
     instead of five (anchor / select / descriptors / scan / compact) and
     XLA fuses across the former stage boundaries. The staged builders
-    stay as profiling hooks (bench_profile.py)."""
+    stay as profiling hooks (bench_profile.py).
+
+    cap_mode='tight' provisions the segment LANES (the repacked batch,
+    the SHA strip grid, and the compaction capacity) at ~1.1x the
+    expected segment count instead of the all-boundaries-at-seg_min
+    worst case (_tight_segment_lanes). The select SCAN always runs at
+    the full bound — it is lane-count-independent and computing the
+    complete boundary list keeps the returned ``consumed`` carry exact
+    even when the lane tables truncate, so the pipelined walk's
+    downstream windows (which chain on the device carry at dispatch
+    time) never need repair. ``seg_overflow`` is nonzero iff the region
+    really has more segments than the lanes hold (strict: an exact fit
+    is not an overflow) — region_collect raises CutCapacityOverflow and
+    the caller redispatches THIS window at 'full', exactly like the cut
+    capacity."""
     import jax
+    import jax.numpy as jnp
 
     m_words = recover_m_words(total_words, params)
     m_tiles = m_words * 4 // TILE_BYTES
     cap = m_words * 4 // params.seg_min + 1
-    s_pad = -(-cap // lane_multiple) * lane_multiple
+    if cap_mode == "tight":
+        s_pad = _tight_segment_lanes(params, m_words, lane_multiple)
+    else:
+        s_pad = -(-cap // lane_multiple) * lane_multiple
+    tight = cap_mode == "tight"
     anchor = make_anchor_fn(params, m_words)
     select = make_select_fn(params, m_tiles, cap)
     desc = make_descriptor_fn(params, cap, s_pad)
@@ -641,10 +693,12 @@ def make_chain_fn(params: AnchoredCdcParams, total_words: int,
         tiles = anchor(words)
         bounds = select(tiles, start0, n, final)
         (starts, seg_lens, w_off, sh8, real_blocks, tail_len,
-         consumed) = desc(bounds, start0)
+         consumed, nseg) = desc(bounds, start0)
+        seg_overflow = (nseg > jnp.int32(s_pad)) if tight \
+            else jnp.int32(0)
         count, q, offs, lens, dig = segfn(words, w_off, sh8, real_blocks,
                                           tail_len, starts, seg_lens)
-        return consumed, count, q, offs, lens, dig
+        return consumed, seg_overflow, count, q, offs, lens, dig
 
     return run
 
@@ -729,7 +783,8 @@ def region_dispatch(words, n: int, start0, final: bool,
     already device_put). ``start0`` may be a host int or a device scalar —
     a device scalar keeps a multi-region walk entirely free of host syncs
     (the carry chains on device). Returns device arrays
-    (consumed i32, count i32, q, offs, lens, digests); nothing blocks.
+    (consumed i32, seg_overflow i32, count i32, q, offs, lens, digests);
+    nothing blocks.
 
     The n/start0/final scalars are cached device constants — re-putting
     them per region measured ~4 ms each over a tunneled link (dispatch is
@@ -751,7 +806,13 @@ def region_collect(out) -> tuple[list[tuple[int, int, str]], int]:
 
     from dfs_tpu.ops.cdc_pipeline import digests_to_hex
 
-    consumed, count, q, offs, lens, dig = jax.device_get(out)
+    consumed, seg_of, count, q, offs, lens, dig = jax.device_get(out)
+    if int(seg_of):
+        # more segments than the tight lane provisioning — the lane
+        # tables dropped the tail segments (consumed is still exact:
+        # the select scan ran at the full bound); redispatch at "full"
+        raise CutCapacityOverflow("segment lanes overflowed tight "
+                                  "provisioning")
     count = int(count)
     if count > q.shape[0]:
         # content denser than the tight provisioning (cap_mode="tight" in

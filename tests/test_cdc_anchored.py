@@ -404,3 +404,67 @@ def test_factory_auto_background_reprobe_is_throttled(monkeypatch):
         time.sleep(0.05)
     assert f.name == "cdc-anchored-tpu"
     assert calls["n"] == 2
+
+
+def test_tight_segment_lane_overflow_redispatches(monkeypatch):
+    """Segment LANES are provisioned at ~1.1x the expected count
+    (cap_mode='tight', _tight_segment_lanes); a region with more
+    segments than that must trip the exact on-device bound count and
+    redo at the worst-case bound — byte-identical to the oracle, never
+    a silently truncated chunk table."""
+    import dfs_tpu.ops.cdc_anchored as A
+
+    # force the tight provisioning far below the real segment count so
+    # ORDINARY content overflows the lanes (the select scan fills every
+    # slot); the full-bound redispatch must recover exactly
+    monkeypatch.setattr(A, "_tight_segment_lanes",
+                        lambda params, m_words, lane_multiple: 8)
+    A.make_chain_fn.cache_clear()
+    try:
+        rng = np.random.default_rng(3)
+        data = rng.integers(0, 256, size=100000, dtype=np.uint8)
+        calls: list[str] = []
+        orig = A.region_dispatch
+
+        def spy(*a, **kw):
+            calls.append(kw.get("cap_mode", "tight"))
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(A, "region_dispatch", spy)
+        got = batch_chunks_anchored(data, SMALL, lane_multiple=8)
+        assert "full" in calls, "lane overflow never hit the retry path"
+        assert got == chunk_file_anchored_np(data, SMALL)
+    finally:
+        A.make_chain_fn.cache_clear()
+
+
+def test_tight_segment_lane_overflow_in_pipelined_walk(monkeypatch):
+    """Lane overflow through the MULTI-WINDOW pipelined walk: window k's
+    lane tables truncate, but its device carry (from the full-bound
+    select scan) stays exact, so the windows already dispatched on that
+    carry remain valid and only window k redoes at 'full'. The walk must
+    produce the oracle chunk table with no discontinuity."""
+    import dfs_tpu.fragmenter.cdc_anchored as F
+    import dfs_tpu.ops.cdc_anchored as A
+
+    monkeypatch.setattr(A, "_tight_segment_lanes",
+                        lambda params, m_words, lane_multiple: 8)
+    A.make_chain_fn.cache_clear()
+    try:
+        rng = np.random.default_rng(5)
+        data = rng.integers(0, 256, size=200000, dtype=np.uint8).tobytes()
+        calls: list[str] = []
+        orig = F.region_chunks
+
+        def spy(*a, **kw):
+            calls.append(kw.get("cap_mode", "tight"))
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(F, "region_chunks", spy)
+        got = anchored_frag(region_bytes=65536, max_inflight=3).chunk(data)
+        assert "full" in calls, "walk never hit the lane-overflow retry"
+        arr = np.frombuffer(data, np.uint8)
+        assert [(c.offset, c.length, c.digest) for c in got] == \
+            chunk_file_anchored_np(arr, SMALL)
+    finally:
+        A.make_chain_fn.cache_clear()

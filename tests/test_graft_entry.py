@@ -24,7 +24,8 @@ def test_entry_compiles_and_runs():
 
     fn, args = __graft_entry__.entry()
     jitted = jax.jit(fn)
-    consumed, count, q, offs, lens, dig = jitted(*args)
+    consumed, seg_of, count, q, offs, lens, dig = jitted(*args)
+    assert int(seg_of) == 0
     count = int(np.asarray(count))
     assert count > 0
     assert int(np.asarray(consumed)) == 128 * 1024   # final region

@@ -859,11 +859,11 @@ class StorageNodeServer:
         self.store.manifests.clear_tombstone(manifest.file_id)
         if not self.store.manifests.save(manifest):
             raise UploadError("manifest save refused (tombstone race)")
+        mj = manifest.to_json()          # once, not once per recipient
 
         async def announce(peer) -> None:
             try:
-                await self.client.announce(peer, manifest.to_json(),
-                                           fresh=True)
+                await self.client.announce(peer, mj, fresh=True)
             except RpcError as e:
                 self.log.warning("announce to node %d failed: %s",
                                  peer.node_id, e)

@@ -16,6 +16,7 @@ upload (SURVEY.md §5.4) is preserved by the node runtime.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import tempfile
@@ -53,6 +54,8 @@ class ChunkStore:
         self._root_str = os.fspath(self.root)
         self._count: int | None = None     # lazy; maintained by put/delete
         self._count_lock = threading.Lock()   # puts run in to_thread pools
+        self._dirs: set[str] = set()       # subdirs known to exist
+        self._tmp_seq = itertools.count()  # cheap unique tmp names
 
     def _path(self, digest: str) -> Path:
         if not is_hex_digest(digest):
@@ -84,8 +87,24 @@ class ChunkStore:
         if verify and sha256_hex(data) != digest:
             raise ValueError(f"data does not match digest {digest[:12]}…")
         parent = os.path.dirname(p)
-        os.makedirs(parent, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=parent, prefix=".tmp-")
+        if parent not in self._dirs:       # one mkdir per subdir lifetime
+            os.makedirs(parent, exist_ok=True)
+            self._dirs.add(parent)
+        # pid+sequence tmp names instead of mkstemp: uniqueness within
+        # this store is all that is needed, and mkstemp's random-name
+        # search measured real time at thousands of puts per upload.
+        # O_EXCL collisions (a crash-leaked temp from a previous run of
+        # the same pid — routine for PID-1 containers) just advance the
+        # sequence; the loop touches nothing it did not create, so a
+        # concurrent writer's live temp is never deleted.
+        while True:
+            tmp = f"{parent}/.tmp-{os.getpid()}-{next(self._tmp_seq)}"
+            try:
+                fd = os.open(tmp,
+                             os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+                break
+            except FileExistsError:
+                continue
         try:
             with os.fdopen(fd, "wb") as f:
                 f.write(data)
@@ -95,7 +114,7 @@ class ChunkStore:
                 return False
         finally:
             try:
-                os.unlink(tmp)
+                os.unlink(tmp)       # ours: the O_EXCL open succeeded
             except OSError:
                 pass
         with self._count_lock:

@@ -8,7 +8,7 @@
 // client CI runs against a live sidecar (tests/test_sidecar_wire.py)
 // and the reference implementation a foreign host can crib from.
 //
-//   usage: sidecar_client <host> <port> <file>
+//   usage: sidecar_client <host> <port> <file> [method]
 //
 // Streams <file> into /dfs.Sidecar/ChunkHashStream as gRPC
 // length-prefixed messages over an HTTP/2 cleartext (h2c,
@@ -244,11 +244,19 @@ std::string hpack_request_headers(const std::string& authority,
 }  // namespace
 
 int main(int argc, char** argv) {
-  if (argc != 4) {
-    std::fprintf(stderr, "usage: %s <host> <port> <file>\n", argv[0]);
+  if (argc != 4 && argc != 5) {
+    std::fprintf(stderr,
+                 "usage: %s <host> <port> <file> [method]\n"
+                 "  method: ChunkHashStream (default), ChunkHash, Health\n",
+                 argv[0]);
     return 2;
   }
   const std::string host = argv[1], port = argv[2], path = argv[3];
+  const std::string method = argc == 5 ? argv[4] : "ChunkHashStream";
+  if (method != "ChunkHashStream" && method != "ChunkHash" &&
+      method != "Health")
+    die("unknown method " + method +
+        " (want ChunkHashStream, ChunkHash, or Health)");
 
   addrinfo hints{}, *res = nullptr;
   hints.ai_family = AF_UNSPEC;
@@ -273,25 +281,51 @@ int main(int argc, char** argv) {
   write_all(c.fd, s.data(), s.size());
 
   std::string hb = hpack_request_headers(
-      host + ":" + port, "/dfs.Sidecar/ChunkHashStream");
+      host + ":" + port, "/dfs.Sidecar/" + method);
   std::string hf = frame(kHeaders, kEndHeaders, 1, hb);
   write_all(c.fd, hf.data(), hf.size());
 
-  // stream the file as gRPC length-prefixed messages:
-  // [1-byte compressed flag = 0][4-byte big-endian length][payload]
+  // the request as gRPC length-prefixed messages:
+  // [1-byte compressed flag = 0][4-byte big-endian length][payload].
+  // ChunkHashStream: one message per file block. ChunkHash: the whole
+  // file in ONE message. Health: one empty message (the file argument
+  // is ignored beyond being openable).
   std::vector<char> block(64 * 1024);
-  std::string msg;
-  for (;;) {
-    size_t n = std::fread(block.data(), 1, block.size(), f);
-    if (n == 0) break;
-    msg.clear();
-    msg.push_back('\0');
-    msg.push_back(static_cast<char>((n >> 24) & 0xFF));
-    msg.push_back(static_cast<char>((n >> 16) & 0xFF));
-    msg.push_back(static_cast<char>((n >> 8) & 0xFF));
-    msg.push_back(static_cast<char>(n & 0xFF));
-    msg.append(block.data(), n);
-    c.send_flow_controlled(msg.data(), msg.size(), false);
+  auto send_prefix = [&c](uint64_t n) {
+    if (n > 0xFFFFFFFFULL) die("gRPC message too large (4 GiB-1 cap)");
+    char hdr[5] = {'\0', static_cast<char>((n >> 24) & 0xFF),
+                   static_cast<char>((n >> 16) & 0xFF),
+                   static_cast<char>((n >> 8) & 0xFF),
+                   static_cast<char>(n & 0xFF)};
+    c.send_flow_controlled(hdr, 5, false);
+  };
+  if (method == "Health") {
+    send_prefix(0);
+  } else if (method == "ChunkHash") {
+    // one message for the whole file: the prefix comes from the file
+    // size and the payload streams through — the gRPC message framing
+    // has no alignment to DATA frames, so no whole-file buffer needed
+    if (std::fseek(f, 0, SEEK_END) != 0) die("seek failed");
+    long sz = std::ftell(f);
+    if (sz < 0) die("ftell failed");
+    std::rewind(f);
+    send_prefix(static_cast<uint64_t>(sz));
+    uint64_t sent = 0;
+    for (;;) {
+      size_t n = std::fread(block.data(), 1, block.size(), f);
+      if (n == 0) break;
+      c.send_flow_controlled(block.data(), n, false);
+      sent += n;
+    }
+    if (sent != static_cast<uint64_t>(sz))
+      die("file changed size mid-read");
+  } else {  // ChunkHashStream (validated in main's prologue)
+    for (;;) {
+      size_t n = std::fread(block.data(), 1, block.size(), f);
+      if (n == 0) break;
+      send_prefix(n);
+      c.send_flow_controlled(block.data(), n, false);
+    }
   }
   std::fclose(f);
   std::string fin = frame(kData, kEndStream, 1, "");  // half-close

@@ -68,3 +68,35 @@ def test_cpp_client_empty_file(tmp_path, sidecar):
     assert out.returncode == 0, out.stderr.decode()
     table = json.loads(out.stdout)
     assert table["size"] == 0 and table["chunks"] == []
+
+
+def test_cpp_client_health_and_unary_methods(tmp_path, rng, sidecar):
+    """The other documented methods through the same library-less
+    client: Health (empty message -> JSON status incl. the stream_span
+    'window' bound) and unary ChunkHash (whole payload in one gRPC
+    message, table identical to the streamed path)."""
+    binary = build_sidecar_client()
+    assert binary is not None
+
+    data = rng.integers(0, 256, size=300_000, dtype=np.uint8).tobytes()
+    payload = tmp_path / "p.bin"
+    payload.write_bytes(data)
+
+    out = subprocess.run(
+        [str(binary), "127.0.0.1", str(sidecar.port), str(payload),
+         "Health"], capture_output=True, timeout=120)
+    assert out.returncode == 0, out.stderr.decode()
+    health = json.loads(out.stdout)
+    assert health["ok"] is True
+    assert health["fragmenter"] == "cdc-anchored"
+    assert health["window"] == (sidecar.fragmenter.stream_span() or 0)
+
+    out = subprocess.run(
+        [str(binary), "127.0.0.1", str(sidecar.port), str(payload),
+         "ChunkHash"], capture_output=True, timeout=120)
+    assert out.returncode == 0, out.stderr.decode()
+    table = json.loads(out.stdout)
+    want = sidecar.fragmenter.chunk(data)
+    assert [(g["offset"], g["length"], g["digest"])
+            for g in table["chunks"]] \
+        == [(r.offset, r.length, r.digest) for r in want]

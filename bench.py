@@ -146,7 +146,8 @@ def main() -> int:
     # 4.1 ms "calm" read). With k_hi - k_lo = 30 the same outlier moves
     # it by at most ~1.3 ms, below the quantity being measured.
     k_lo, k_hi = 10, max(passes, 40)
-    reps = 20
+    reps = 28      # ~3 min spread: a worst-hour driver run still gets
+    #                several chances at calm plateaus on BOTH chain sizes
     t_lo, t_hi = [], []
     t_start = time.perf_counter()
     for rep in range(reps):

@@ -55,7 +55,7 @@ def main() -> int:
           f"s_pad={s_pad} (tight lanes)", file=sys.stderr)
 
     anchor = A.make_anchor_fn(params, m_words)
-    select = A.make_select_fn(params, m_tiles, cap)
+    select = A.make_select(params, m_tiles, cap)   # Pallas walk on TPU
     desc = A.make_descriptor_fn(params, cap, s_pad)
     seg = A.make_anchored_segment_fn(params, int(words.shape[0]), s_pad)
 

@@ -321,7 +321,9 @@ def make_select_fn(params: AnchoredCdcParams, m_tiles: int, cap: int):
     import jax
     import jax.numpy as jnp
 
-    win = (params.seg_max - params.seg_min) // TILE_BYTES + 1
+    from dfs_tpu.ops.select_pallas import select_window_tiles
+
+    win = select_window_tiles(params)
     seg_min = jnp.int32(params.seg_min)
     seg_max = jnp.int32(params.seg_max)
 
@@ -358,6 +360,21 @@ def make_select_fn(params: AnchoredCdcParams, m_tiles: int, cap: int):
         return bounds
 
     return run
+
+
+def make_select(params: AnchoredCdcParams, m_tiles: int, cap: int):
+    """The production select: the Pallas on-core walk when the backend
+    and window geometry support it (measured 0.17 ms vs 1.4 ms for the
+    unrolled XLA scan per 64 MiB region on v5e — the walk is the
+    chain's only sequential stage), else the XLA scan. Both are pinned
+    bit-identical by tests (interpret mode + the on-chip equality the
+    chain's hashlib gates imply)."""
+    from dfs_tpu.ops.select_pallas import (make_select_fn_pallas,
+                                           select_pallas_supported)
+
+    if select_pallas_supported(params):
+        return make_select_fn_pallas(params, m_tiles, cap)
+    return make_select_fn(params, m_tiles, cap)
 
 
 # ---------------------------------------------------------------------------
@@ -684,7 +701,7 @@ def make_chain_fn(params: AnchoredCdcParams, total_words: int,
         s_pad = -(-cap // lane_multiple) * lane_multiple
     tight = cap_mode == "tight"
     anchor = make_anchor_fn(params, m_words)
-    select = make_select_fn(params, m_tiles, cap)
+    select = make_select(params, m_tiles, cap)
     desc = make_descriptor_fn(params, cap, s_pad)
     segfn = make_anchored_segment_fn(params, total_words, s_pad, cap_mode)
 

@@ -1,0 +1,147 @@
+"""Pallas segment-selection walk: the sequential boundary scan on-core.
+
+The XLA form (ops.cdc_anchored.make_select_fn) is a 683-step lax.scan
+whose per-step work is trivial but whose per-step overhead is not: even
+unrolled 8-wide it measures ~1.0-1.6 ms per 64 MiB region on v5e —
+second only to the SHA scan in the chain profile, for what is
+fundamentally ~683 * ~50 vector-lane operations. This kernel runs the
+whole walk inside ONE Pallas program: the anchor-tile array DMAs into
+VMEM once (~0.5 MB), each step reads a 16x128 block around its
+selection window (8-row aligned, the Mosaic sublane-slice granularity)
+and takes a masked max, and the boundary list accumulates in registers
+via an iota select — no dynamic lane stores, no per-step dispatch.
+
+Semantics are bit-identical to make_select_fn (the equality tests pin
+both, and make_chain_fn only uses this path on TPU after the shapes
+check out — everything else falls back to the XLA scan):
+
+    window  = kept anchors in byte range [lo-1, hi-1],
+              lo = start + seg_min, hi = start + seg_max
+    bound   = last anchor in window + 1, else forced hi
+    final n-bound emitted when remaining <= seg_max; for non-final
+    regions the tail segment is withheld (carried to the next region).
+
+Capability anchor: replaces the reference's implicit fixed split-point
+arithmetic (StorageNode.java:138-155) at the segment level — the walk
+is the only sequential stage of the anchored chain.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_ROW_TILE = 8          # Mosaic sublane-slice granularity for [*, 128]
+_WIN_ROWS = 16         # 8-row-aligned window start => off < 1024, and
+#                        off + 65 <= 16*128 always
+
+
+def select_window_tiles(params) -> int:
+    """Selection-window width in tiles — THE single definition (the XLA
+    scan, this kernel, and the support gate all call it, so a future
+    window change — e.g. the recorded two-anchors-per-tile pickup —
+    cannot desynchronize them)."""
+    from dfs_tpu.ops.cdc_anchored import TILE_BYTES
+
+    return (params.seg_max - params.seg_min) // TILE_BYTES + 1
+
+
+def select_pallas_supported(params) -> bool:
+    """The kernel reads a [16, 128] block per step: windows wider than
+    one block minus the worst alignment residual (1024) cannot use it.
+    Default params: win = 65."""
+    win = select_window_tiles(params)
+    return jax.default_backend() == "tpu" \
+        and win + (_ROW_TILE - 1) * 128 + 127 <= _WIN_ROWS * 128
+
+
+@functools.cache
+def make_select_fn_pallas(params, m_tiles: int, cap: int,
+                          interpret: bool = False):
+    """Compiled: (tiles [m_tiles] i32, start0 i32, n i32, final bool) ->
+    bounds [cap] i32 — drop-in twin of make_select_fn."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from dfs_tpu.ops.cdc_anchored import TILE_BYTES
+
+    win = select_window_tiles(params)
+    seg_min = params.seg_min
+    seg_max = params.seg_max
+    # padded tile count: the walk's last window may start past m_tiles
+    # (start approaches n); sentinels there never select. Rounded so the
+    # [R, 128] view is whole and a 16-row read at the last window fits.
+    t0_max = m_tiles + seg_min // TILE_BYTES + 1
+    need = t0_max + win + _WIN_ROWS * 128 + _ROW_TILE * 128
+    m_pad = -(-need // 1024) * 1024
+    rows = m_pad // 128
+    cap_pad = -(-cap // 128) * 128
+
+    def kernel(scal_ref, tiles_hbm, out_ref, tiles_vmem, sem):
+        cp = pltpu.make_async_copy(tiles_hbm, tiles_vmem, sem)
+        cp.start()
+        cp.wait()
+        start0 = scal_ref[0]
+        n = scal_ref[1]
+        final = scal_ref[2]
+
+        col = jax.lax.broadcasted_iota(jnp.int32, (_WIN_ROWS, 128), 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (_WIN_ROWS, 128), 0)
+        lane = jax.lax.iota(jnp.int32, cap_pad)
+
+        def body(i, carry):
+            start, done, acc = carry
+            lo = start + seg_min
+            hi = start + seg_max
+            t0 = (lo - 1) // TILE_BYTES
+            r0 = (t0 // 128 // _ROW_TILE) * _ROW_TILE
+            r0 = pl.multiple_of(r0, _ROW_TILE)
+            block = tiles_vmem[pl.ds(r0, _WIN_ROWS), :]
+            g = (row + r0) * 128 + col            # global tile index
+            val = block
+            ok = (g >= t0) & (g <= t0 + (win - 1)) \
+                & (val >= lo - 1) & (val <= hi - 1)
+            last = jnp.max(jnp.where(ok, val, -1))
+            b = jnp.where(last >= 0, last + 1, hi)
+            fin = (n - start <= seg_max).astype(jnp.int32)
+            b = jnp.where(fin == 1, n, b)
+            emit = (done == 0) & ((fin == 0) | (final == 1))
+            out = jnp.where(emit, b, -1)
+            acc = jnp.where(lane == i, out, acc)
+            start = jnp.where(out >= 0, b, start)
+            return start, done | fin, acc
+
+        _, _, acc = jax.lax.fori_loop(
+            0, cap, body,
+            (start0, jnp.int32(0),
+             jnp.full((cap_pad,), -1, jnp.int32)))
+        out_ref[...] = acc
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((rows, 128), jnp.int32),
+                        pltpu.SemaphoreType.DMA],
+    )
+
+    @jax.jit
+    def run(tiles, start0, n, final):
+        tiles_p = jnp.concatenate(
+            [tiles, jnp.full((m_pad - m_tiles,), 2**30, jnp.int32)]
+        ).reshape(rows, 128)
+        scal = jnp.stack([start0.astype(jnp.int32),
+                          jnp.int32(n),
+                          final.astype(jnp.int32)])
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((cap_pad,), jnp.int32),
+            interpret=interpret,
+        )(scal, tiles_p)
+        return out[:cap]
+
+    return run

@@ -468,3 +468,71 @@ def test_tight_segment_lane_overflow_in_pipelined_walk(monkeypatch):
             chunk_file_anchored_np(arr, SMALL)
     finally:
         A.make_chain_fn.cache_clear()
+
+
+def test_pallas_select_matches_xla_scan():
+    """The on-core Pallas selection walk (ops.select_pallas) must agree
+    with the XLA scan bit-for-bit: random anchor-tile patterns, final
+    and non-final regions, zero and carried start0. Interpret mode on
+    CPU; on real TPU the same kernel is exercised end-to-end by
+    bench.py's hashlib gates (make_chain_fn picks it there)."""
+    import jax.numpy as jnp
+
+    from dfs_tpu.ops.select_pallas import make_select_fn_pallas
+
+    rng = np.random.default_rng(11)
+    params = SMALL
+    for trial in range(2):
+        n = int(rng.integers(20000, 120000))
+        m_tiles = 1 << (-(-n // TILE_BYTES) - 1).bit_length()
+        cap = m_tiles * TILE_BYTES // params.seg_min + 1
+        tiles = np.full(m_tiles, 2**30, np.int32)
+        k = int(rng.integers(1, m_tiles))
+        idx = rng.choice(m_tiles, size=k, replace=False)
+        tiles[idx] = (idx * TILE_BYTES
+                      + rng.integers(0, TILE_BYTES, size=k)
+                      ).astype(np.int32)
+        import dfs_tpu.ops.cdc_anchored as A
+        for final in (True, False):
+            for start0 in (0, 1234):
+                ref = A.make_select_fn(params, m_tiles, cap)(
+                    jnp.asarray(tiles), jnp.int32(start0), jnp.int32(n),
+                    jnp.bool_(final))
+                got = make_select_fn_pallas(
+                    params, m_tiles, cap, interpret=True)(
+                    jnp.asarray(tiles), jnp.int32(start0), jnp.int32(n),
+                    jnp.bool_(final))
+                np.testing.assert_array_equal(
+                    np.asarray(ref), np.asarray(got))
+
+
+def test_pallas_select_large_region_block_addressing():
+    """Production-shaped geometry (96K/128K segments, 4 MiB region):
+    t0 crosses the 1024-entry block boundary many times, so the kernel's
+    8-row-aligned dynamic block read and (row + r0)*128 + col global
+    index arithmetic are actually exercised (the small-n test's windows
+    all start in block zero)."""
+    import jax.numpy as jnp
+
+    import dfs_tpu.ops.cdc_anchored as A
+    from dfs_tpu.ops.select_pallas import make_select_fn_pallas
+
+    params = AnchoredCdcParams()        # production segment geometry
+    n = 4 * 2**20
+    m_tiles = n // TILE_BYTES           # 8192 tiles -> t0 up to ~8192
+    cap = n // params.seg_min + 1
+    rng = np.random.default_rng(12)
+    tiles = np.full(m_tiles, 2**30, np.int32)
+    idx = rng.choice(m_tiles, size=m_tiles // 16, replace=False)
+    tiles[idx] = (idx * TILE_BYTES
+                  + rng.integers(0, TILE_BYTES, size=idx.size)
+                  ).astype(np.int32)
+    for final in (True, False):
+        ref = A.make_select_fn(params, m_tiles, cap)(
+            jnp.asarray(tiles), jnp.int32(0), jnp.int32(n),
+            jnp.bool_(final))
+        got = make_select_fn_pallas(params, m_tiles, cap,
+                                    interpret=True)(
+            jnp.asarray(tiles), jnp.int32(0), jnp.int32(n),
+            jnp.bool_(final))
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))

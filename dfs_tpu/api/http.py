@@ -348,9 +348,9 @@ async def _serve_one(node: "StorageNodeServer",
                 manifest, stats = await node.upload_stream(
                     body, query.get("name", ""))
             except UploadError as e:
-                return plain(500, str(e))
+                return plain(getattr(e, "status", 500), str(e))
             except ValueError as e:
-                return plain(400, f"Bad chunked body: {e}")
+                return plain(400, f"Bad request body: {e}")
         else:
             data = await reader.readexactly(content_length)
             try:

@@ -12,6 +12,8 @@ chunk, exactly who should hold it (no manifest needed for repair).
 
 from __future__ import annotations
 
+from typing import Sequence
+
 
 def replica_set(digest: str, node_ids: list[int], rf: int) -> list[int]:
     """Deterministic replica node-ids for a chunk digest. ``node_ids`` must be
@@ -41,7 +43,8 @@ def ec_shard_node(file_id: str, stripe: int, shard: int,
     return node_ids[(base + shard) % len(node_ids)]
 
 
-def handoff_order(pinned: list[int], node_ids: list[int]) -> list[int]:
+def handoff_order(pinned: Sequence[int],
+                  node_ids: list[int]) -> list[int]:
     """The agreed candidate order for a PINNED (erasure-coded) shard:
     its pinned holders, then the membership ring cyclically from the
     first pinned holder. Upload's sloppy-quorum handoff walks exactly

@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import asyncio
 import math
+import types
+from typing import Mapping, Sequence
 
 from dfs_tpu.comm.rpc import InternalClient, RpcError, RpcUnreachable
 from dfs_tpu.comm.wire import (WireError, pack_chunks, read_msg, send_msg,
@@ -73,7 +75,7 @@ class RangeNotSatisfiable(DownloadError):
 
 
 def ec_placement_map(manifest: Manifest,
-                     node_ids: list[int]) -> dict[str, list[int]]:
+                     node_ids: list[int]) -> Mapping[str, tuple[int, ...]]:
     """digest -> candidate holder nodes for every shard (data + parity)
     of an erasure-coded manifest. Derived from the manifest alone
     (node.placement.ec_shard_node), so any node can locate any shard.
@@ -103,7 +105,7 @@ _EC_PLACEMENT_CACHE: dict = {}
 
 
 def _ec_placement_build(manifest: Manifest, node_ids: list[int]
-                        ) -> dict[str, list[int]]:
+                        ) -> Mapping[str, tuple[int, ...]]:
     ec = manifest.ec
     assert ec is not None
     pl: dict[str, list[int]] = {}
@@ -116,7 +118,12 @@ def _ec_placement_build(manifest: Manifest, node_ids: list[int]
             ec_shard_node(manifest.file_id, s, len(grp), node_ids))
         pl.setdefault(st.q, []).append(
             ec_shard_node(manifest.file_id, s, len(grp) + 1, node_ids))
-    return {d: list(dict.fromkeys(v)) for d, v in pl.items()}
+    # read-only view over tuple values: the map is cached and shared by
+    # every reader of this (manifest, membership) pair — a caller
+    # mutating it would corrupt placement for all subsequent reads, so
+    # violations fail loudly instead of silently.
+    return types.MappingProxyType(
+        {d: tuple(dict.fromkeys(v)) for d, v in pl.items()})
 
 
 def ec_shard_items(manifest: Manifest) -> list[tuple[str, int]]:
@@ -692,7 +699,7 @@ class StorageNodeServer:
     async def _place_batch(self, file_id: str,
                            batch: list[tuple[str, bytes]],
                            stats: dict, rf: int | None = None,
-                           placement: dict[str, list[int]] | None = None
+                           placement: Mapping[str, tuple[int, ...]] | None = None
                            ) -> None:
         """Place one batch of unique (digest, payload) chunks: local puts
         for canonical ownership, concurrent replication with hash-echo
@@ -709,7 +716,7 @@ class StorageNodeServer:
             rf = self.cfg.cluster.replication_factor
         placement = placement or {}
 
-        def primary_targets(digest: str) -> list[int]:
+        def primary_targets(digest: str) -> Sequence[int]:
             return placement.get(digest) \
                 or replica_set(digest, ids, rf)
 
@@ -954,7 +961,7 @@ class StorageNodeServer:
         pref = ec_placement_map(manifest, ids) \
             if manifest is not None and manifest.ec is not None else {}
 
-        def candidates_for(d: str) -> list[int]:
+        def candidates_for(d: str) -> Sequence[int]:
             pinned = pref.get(d)
             if pinned:
                 # pinned + the cyclic handoff continuation: a shard that

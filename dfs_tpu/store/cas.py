@@ -16,6 +16,7 @@ upload (SURVEY.md §5.4) is preserved by the node runtime.
 
 from __future__ import annotations
 
+import errno
 import itertools
 import json
 import os
@@ -30,7 +31,7 @@ from dfs_tpu.utils.hashing import sha256_hex
 
 
 def _atomic_write(path: Path | str, data: bytes) -> None:
-    parent = os.path.dirname(os.fspath(path))
+    parent = os.path.dirname(os.fspath(path)) or "."
     os.makedirs(parent, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=parent, prefix=".tmp-")
     try:
@@ -43,6 +44,27 @@ def _atomic_write(path: Path | str, data: bytes) -> None:
         except OSError:
             pass
         raise
+
+
+_TMP_SWEEP_AGE_S = 3600.0
+
+
+def _sweep_tmp_files(dirs, max_age_s: float = _TMP_SWEEP_AGE_S) -> int:
+    """Unlink ``.tmp-*`` entries older than ``max_age_s`` in the given
+    directories; returns the number removed. Shared by the chunk and
+    manifest stores — both leak the same class of temp file on a crash
+    between create and link/rename."""
+    cutoff = time.time() - max_age_s
+    n = 0
+    for d in dirs:
+        for p in d.glob(".tmp-*"):
+            try:
+                if p.stat().st_mtime <= cutoff:
+                    p.unlink()
+                    n += 1
+            except OSError:
+                continue
+    return n
 
 
 class ChunkStore:
@@ -112,6 +134,20 @@ class ChunkStore:
                 os.link(tmp, p)
             except FileExistsError:
                 return False
+            except OSError as e:
+                # filesystem without hard links (or cross-device layout):
+                # fall back to atomic rename. Loses the exactly-one-True
+                # race guarantee (both racers see True, count drifts by
+                # one until restart) but never loses data — rename is
+                # still atomic and content-addressed names make the
+                # overwrite idempotent. Only the no-hardlink errnos take
+                # the fallback; anything else (vanished tmp, EIO) stays
+                # loud with its real cause.
+                if e.errno not in (errno.EPERM, errno.EOPNOTSUPP,
+                                   errno.ENOTSUP, errno.EXDEV,
+                                   errno.EMLINK):
+                    raise
+                os.replace(tmp, p)
         finally:
             try:
                 os.unlink(tmp)       # ours: the O_EXCL open succeeded
@@ -171,6 +207,20 @@ class ChunkStore:
 
     def total_bytes(self) -> int:
         return sum((self.root / d[:2] / d).stat().st_size for d in self.digests())
+
+    def sweep_tmp(self) -> int:
+        """Reclaim crash-leaked ``.tmp-*`` files. ``put()`` only ever
+        unlinks temps it created in THIS process; a crash between open
+        and unlink leaks one, and the pid+sequence naming never revisits
+        it. The fixed hour age gate is load-bearing (deliberately not a
+        parameter): delete-triggered GC runs while puts run in thread
+        workers, and sweeping a live temp between its open and os.link
+        would fail that upload — a leaked temp older than an hour cannot
+        belong to any in-flight put."""
+        dirs = [sub for sub in
+                (self.root.iterdir() if self.root.is_dir() else [])
+                if sub.is_dir()]
+        return _sweep_tmp_files(dirs)
 
 
 class ManifestStore:
@@ -283,6 +333,11 @@ class ManifestStore:
             except FileNotFoundError:
                 return None
 
+    def sweep_tmp(self) -> int:
+        """Reclaim crash-leaked ``_atomic_write`` temps (crash between
+        mkstemp and replace) — same hour age gate as the chunk store."""
+        return _sweep_tmp_files([self.root])
+
     def mtime(self, file_id: str) -> float | None:
         """Manifest file mtime — the 'written at' ordering side of
         last-writer-wins against tombstone timestamps."""
@@ -329,4 +384,7 @@ class NodeStore:
             dead.append(d)
         for d in dead:
             self.chunks.delete(d)
+        # hour-gated: never races a live put or manifest write
+        self.chunks.sweep_tmp()
+        self.manifests.sweep_tmp()
         return dead

@@ -202,7 +202,7 @@ def test_ec_handoff_shard_readable_without_sweep(tmp_path, rng):
             assert stats["handoffChunks"] > 0, "expected handoff"
             pl = ec_placement_map(manifest, ids)
             handed = [d for d, holders in pl.items()
-                      if holders == [2]
+                      if tuple(holders) == (2,)
                       and not any(n in nodes and nodes[n].store.chunks
                                   .has(d) for n in holders)]
             assert handed, "expected shards pinned to the dead node"

@@ -89,3 +89,61 @@ def test_manifest_listing(tmp_path, example_files):
             example_files[n], name=n))
     listed = {m.name for m in ns.manifests.list()}
     assert listed == set(names)
+
+
+def test_sweep_tmp_reclaims_only_aged_leaks(tmp_path):
+    """Crash-leaked .tmp-* files (put: open->crash before link;
+    _atomic_write: mkstemp->crash before replace) are reclaimed by the
+    hour-gated sweep; anything younger — a live put's temp — is not."""
+    import os
+    import time as _time
+    ns = NodeStore(tmp_path, node_id=3)
+    d = sha256_hex(b"x")
+    ns.chunks.put(d, b"x")          # creates chunks/<d[:2]>/
+    sub = ns.chunks.root / d[:2]
+    old_c = sub / ".tmp-999-0"
+    new_c = sub / ".tmp-999-1"
+    old_m = ns.manifests.root / ".tmp-leak"
+    for p in (old_c, new_c, old_m):
+        p.write_bytes(b"leak")
+    past = _time.time() - 7200
+    os.utime(old_c, (past, past))
+    os.utime(old_m, (past, past))
+    assert ns.chunks.sweep_tmp() == 1
+    assert ns.manifests.sweep_tmp() == 1
+    assert not old_c.exists() and not old_m.exists()
+    assert new_c.exists()           # younger than the gate: untouched
+    assert ns.chunks.get(d) == b"x"
+    new_c.unlink()
+
+
+def test_put_falls_back_to_replace_without_hardlinks(tmp_path, monkeypatch):
+    """Filesystems without hard links take the os.replace fallback; a
+    link failure that is NOT a no-hardlink errno stays loud."""
+    import errno as _errno
+    import os
+    from dfs_tpu.store.cas import ChunkStore
+    cs = ChunkStore(tmp_path / "c")
+    real_link = os.link
+
+    def no_links(src, dst, **kw):
+        raise OSError(_errno.EOPNOTSUPP, "no hard links here")
+
+    monkeypatch.setattr(os, "link", no_links)
+    d = sha256_hex(b"payload")
+    assert cs.put(d, b"payload") is True
+    assert cs.get(d) == b"payload"
+    assert cs.put(d, b"payload") is False     # dedup via exists-check
+
+    def vanishing(src, dst, **kw):
+        raise FileNotFoundError(_errno.ENOENT, "tmp vanished", src)
+
+    monkeypatch.setattr(os, "link", vanishing)
+    d2 = sha256_hex(b"other")
+    try:
+        cs.put(d2, b"other")
+    except FileNotFoundError:
+        pass
+    else:
+        raise AssertionError("non-hardlink errno must propagate")
+    monkeypatch.setattr(os, "link", real_link)

@@ -162,11 +162,13 @@ int64_t dfs_anchored_spans_region(const uint8_t* data, uint64_t len,
   *consumed = start0;
   if (len == 0) return 0;
 
-  // ---- pass A: first qualifying anchor per tile (-1 = none) ----
+  // ---- pass A: first TWO qualifying anchors per tile (-1 = none),
+  // interleaved [first, second] per tile — mirrors the device pass-A
+  // two-plane output (dfs_tpu/ops/cdc_anchored.make_anchor_fn) ----
   uint64_t n_tiles = (len + tile_bytes - 1) / tile_bytes;
-  int64_t* tile_anchor = new (std::nothrow) int64_t[n_tiles];
+  int64_t* tile_anchor = new (std::nothrow) int64_t[2 * n_tiles];
   if (!tile_anchor) return -1;
-  for (uint64_t t = 0; t < n_tiles; ++t) tile_anchor[t] = -1;
+  for (uint64_t t = 0; t < 2 * n_tiles; ++t) tile_anchor[t] = -1;
   uint64_t reg = 0;  // bytes[p-7..p], data[p] in the top byte (LE window)
   for (int i = 0; i < 8; ++i)
     reg = (reg >> 8) | (uint64_t(lookback[i]) << 56);
@@ -177,7 +179,8 @@ int64_t dfs_anchored_spans_region(const uint8_t* data, uint64_t len,
     uint32_t h = fmix32(fmix32(b) + anchor_seed + a);
     if ((h & seg_mask) == 0) {
       uint64_t t = p / tile_bytes;
-      if (tile_anchor[t] < 0) tile_anchor[t] = int64_t(p);
+      if (tile_anchor[2 * t] < 0) tile_anchor[2 * t] = int64_t(p);
+      else if (tile_anchor[2 * t + 1] < 0) tile_anchor[2 * t + 1] = int64_t(p);
     }
   }
 
@@ -195,12 +198,17 @@ int64_t dfs_anchored_spans_region(const uint8_t* data, uint64_t len,
       if (!final_region) break;  // tail carries into the next window
       bound = len;               // final segment
     } else {
-      // last kept anchor a with start+seg_min <= a+1 <= start+seg_max
+      // last kept anchor a with start+seg_min <= a+1 <= start+seg_max;
+      // within a tile the second kept anchor is the larger, so it is
+      // checked first
       uint64_t lo = start + seg_min - 1, hi = start + seg_max - 1;
       int64_t found = -1;
       for (uint64_t t = hi / tile_bytes + 1; t-- > lo / tile_bytes;) {
-        int64_t a = tile_anchor[t];
-        if (a >= int64_t(lo) && a <= int64_t(hi)) { found = a; break; }
+        for (int j = 1; j >= 0 && found < 0; --j) {
+          int64_t a = tile_anchor[2 * t + j];
+          if (a >= int64_t(lo) && a <= int64_t(hi)) found = a;
+        }
+        if (found >= 0) break;
       }
       bound = found >= 0 ? uint64_t(found) + 1 : start + seg_max;
     }

@@ -210,6 +210,57 @@ struct Conn {
   }
 };
 
+// Incremental gRPC message parser over Conn::response: returns complete
+// length-prefixed payloads as they accumulate, advancing *consumed.
+bool next_message(const std::string& resp, size_t* consumed,
+                  std::string* out) {
+  if (resp.size() < *consumed + 5) return false;
+  const uint8_t* p =
+      reinterpret_cast<const uint8_t*>(resp.data()) + *consumed;
+  if (p[0] != 0) die("compressed response unsupported");
+  uint32_t mlen = (static_cast<uint32_t>(p[1]) << 24) |
+                  (static_cast<uint32_t>(p[2]) << 16) |
+                  (static_cast<uint32_t>(p[3]) << 8) | p[4];
+  if (resp.size() < *consumed + 5 + mlen) return false;
+  out->assign(resp, *consumed + 5, mlen);
+  *consumed += 5 + static_cast<size_t>(mlen);
+  return true;
+}
+
+// Minimal scanner for `"key": <non-negative integer>` in the sidecar's
+// JSON replies (stdlib json.dumps layout; whitespace after ':' optional).
+// Returns the LAST value of the key, or -1 if absent.
+int64_t last_int_field(const std::string& js, const std::string& key) {
+  const std::string needle = "\"" + key + "\":";
+  int64_t found = -1;
+  size_t at = 0;
+  while ((at = js.find(needle, at)) != std::string::npos) {
+    size_t q = at + needle.size();
+    while (q < js.size() && (js[q] == ' ' || js[q] == '\t')) ++q;
+    int64_t v = 0;
+    bool any = false;
+    while (q < js.size() && js[q] >= '0' && js[q] <= '9') {
+      v = v * 10 + (js[q] - '0');
+      ++q;
+      any = true;
+    }
+    if (any) found = v;
+    at = q;
+  }
+  return found;
+}
+
+// gRPC length-prefix for the next message: [flag=0][4-byte BE length].
+// Single definition — every method's sender goes through it.
+void send_grpc_prefix(Conn& c, uint64_t n) {
+  if (n > 0xFFFFFFFFULL) die("gRPC message too large (4 GiB-1 cap)");
+  char hdr[5] = {'\0', static_cast<char>((n >> 24) & 0xFF),
+                 static_cast<char>((n >> 16) & 0xFF),
+                 static_cast<char>((n >> 8) & 0xFF),
+                 static_cast<char>(n & 0xFF)};
+  c.send_flow_controlled(hdr, 5, false);
+}
+
 // HPACK, encoder side only: static-table indexed fields plus
 // literal-without-indexing — never requires a dynamic table or Huffman.
 std::string hpack_request_headers(const std::string& authority,
@@ -241,23 +292,10 @@ std::string hpack_request_headers(const std::string& authority,
   return hb;
 }
 
-}  // namespace
-
-int main(int argc, char** argv) {
-  if (argc != 4 && argc != 5) {
-    std::fprintf(stderr,
-                 "usage: %s <host> <port> <file> [method]\n"
-                 "  method: ChunkHashStream (default), ChunkHash, Health\n",
-                 argv[0]);
-    return 2;
-  }
-  const std::string host = argv[1], port = argv[2], path = argv[3];
-  const std::string method = argc == 5 ? argv[4] : "ChunkHashStream";
-  if (method != "ChunkHashStream" && method != "ChunkHash" &&
-      method != "Health")
-    die("unknown method " + method +
-        " (want ChunkHashStream, ChunkHash, or Health)");
-
+// Open a connection and start stream 1 for the given method:
+// preface + SETTINGS + HEADERS, ready for request DATA frames.
+Conn dial(const std::string& host, const std::string& port,
+          const std::string& method) {
   addrinfo hints{}, *res = nullptr;
   hints.ai_family = AF_UNSPEC;
   hints.ai_socktype = SOCK_STREAM;
@@ -271,10 +309,6 @@ int main(int argc, char** argv) {
   timeval tv{60, 0};
   setsockopt(c.fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
 
-  FILE* f = std::fopen(path.c_str(), "rb");
-  if (!f) die("cannot open " + path);
-
-  // connection preface + our (empty = all defaults) SETTINGS
   static const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
   write_all(c.fd, kPreface, sizeof(kPreface) - 1);
   std::string s = frame(kSettings, 0, 0, "");
@@ -284,6 +318,121 @@ int main(int argc, char** argv) {
       host + ":" + port, "/dfs.Sidecar/" + method);
   std::string hf = frame(kHeaders, kEndHeaders, 1, hb);
   write_all(c.fd, hf.data(), hf.size());
+  return c;
+}
+
+// Unary Health on its own connection: the duplex tee sizes its buffer
+// cap from the advertised reporting-lag window, exactly like the
+// in-process teeing client (sidecar/service.py SidecarFragmenter).
+int64_t fetch_window(const std::string& host, const std::string& port) {
+  Conn c = dial(host, port, "Health");
+  send_grpc_prefix(c, 0);  // one empty gRPC message
+  std::string fin = frame(kData, kEndStream, 1, "");
+  write_all(c.fd, fin.data(), fin.size());
+  while (!c.done) c.pump();
+  size_t consumed = 0;
+  std::string msg;
+  if (!next_message(c.response, &consumed, &msg))
+    die("no Health response message");
+  ::close(c.fd);
+  int64_t w = last_int_field(msg, "window");
+  if (w < 0) die("Health reply lacks a window field");
+  return w;
+}
+
+// ChunkHashDuplex with the teeing discipline a storage node uses: at
+// most 2*window un-reported bytes in flight (window = Health's
+// reporting-lag bound; 0 = materializing backend -> uncapped), reads
+// interleaved with writes so chunk batches stream back DURING the
+// upload. A sidecar whose real lag exceeded its advertised window
+// would deadlock this client — the 60 s socket timeout turns that
+// into a loud failure, which is the conformance point.
+int run_duplex(const std::string& host, const std::string& port,
+               FILE* f) {
+  int64_t window = fetch_window(host, port);
+  const int64_t cap = window > 0 ? 2 * window : -1;
+
+  Conn c = dial(host, port, "ChunkHashDuplex");
+  std::vector<char> block(64 * 1024);
+  size_t consumed = 0;
+  int64_t sent = 0, reported = 0;  // bytes sent / last reported chunk end
+  bool got_done = false;
+  std::string msg;
+
+  auto drain = [&]() {
+    while (next_message(c.response, &consumed, &msg)) {
+      std::fwrite(msg.data(), 1, msg.size(), stdout);
+      std::fputc('\n', stdout);
+      int64_t off = last_int_field(msg, "offset");
+      int64_t len = last_int_field(msg, "length");
+      if (off >= 0 && len >= 0 && off + len > reported)
+        reported = off + len;
+      if (last_int_field(msg, "size") >= 0 &&
+          msg.find("\"done\"") != std::string::npos)
+        got_done = true;
+    }
+  };
+
+  bool eof = false;
+  while (!eof && !c.done) {   // c.done mid-upload = server ended early;
+    // fall through to the !got_done check instead of writing into (or
+    // cap-blocking on) a dead stream until the socket timeout fires
+    if (cap > 0 && sent - reported >= cap) {
+      // tee buffer full: block until the sidecar reports chunks
+      c.pump();
+      drain();
+      continue;
+    }
+    size_t n = std::fread(block.data(), 1, block.size(), f);
+    if (n == 0) {
+      eof = true;
+      break;
+    }
+    send_grpc_prefix(c, n);
+    c.send_flow_controlled(block.data(), n, false);
+    sent += static_cast<int64_t>(n);
+    drain();  // send_flow_controlled may have pumped response frames
+  }
+  std::string fin = frame(kData, kEndStream, 1, "");
+  write_all(c.fd, fin.data(), fin.size());
+  while (!c.done) {
+    c.pump();
+    drain();
+  }
+  drain();
+  ::close(c.fd);
+  if (!got_done) die("duplex stream ended without a done message");
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 4 && argc != 5) {
+    std::fprintf(stderr,
+                 "usage: %s <host> <port> <file> [method]\n"
+                 "  method: ChunkHashStream (default), ChunkHash, "
+                 "ChunkHashDuplex, Health\n",
+                 argv[0]);
+    return 2;
+  }
+  const std::string host = argv[1], port = argv[2], path = argv[3];
+  const std::string method = argc == 5 ? argv[4] : "ChunkHashStream";
+  if (method != "ChunkHashStream" && method != "ChunkHash" &&
+      method != "ChunkHashDuplex" && method != "Health")
+    die("unknown method " + method +
+        " (want ChunkHashStream, ChunkHash, ChunkHashDuplex, or Health)");
+
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) die("cannot open " + path);
+
+  if (method == "ChunkHashDuplex") {
+    int rc = run_duplex(host, port, f);
+    std::fclose(f);
+    return rc;
+  }
+
+  Conn c = dial(host, port, method);
 
   // the request as gRPC length-prefixed messages:
   // [1-byte compressed flag = 0][4-byte big-endian length][payload].
@@ -291,16 +440,8 @@ int main(int argc, char** argv) {
   // file in ONE message. Health: one empty message (the file argument
   // is ignored beyond being openable).
   std::vector<char> block(64 * 1024);
-  auto send_prefix = [&c](uint64_t n) {
-    if (n > 0xFFFFFFFFULL) die("gRPC message too large (4 GiB-1 cap)");
-    char hdr[5] = {'\0', static_cast<char>((n >> 24) & 0xFF),
-                   static_cast<char>((n >> 16) & 0xFF),
-                   static_cast<char>((n >> 8) & 0xFF),
-                   static_cast<char>(n & 0xFF)};
-    c.send_flow_controlled(hdr, 5, false);
-  };
   if (method == "Health") {
-    send_prefix(0);
+    send_grpc_prefix(c, 0);
   } else if (method == "ChunkHash") {
     // one message for the whole file: the prefix comes from the file
     // size and the payload streams through — the gRPC message framing
@@ -309,7 +450,7 @@ int main(int argc, char** argv) {
     long sz = std::ftell(f);
     if (sz < 0) die("ftell failed");
     std::rewind(f);
-    send_prefix(static_cast<uint64_t>(sz));
+    send_grpc_prefix(c, static_cast<uint64_t>(sz));
     uint64_t sent = 0;
     for (;;) {
       size_t n = std::fread(block.data(), 1, block.size(), f);
@@ -323,7 +464,7 @@ int main(int argc, char** argv) {
     for (;;) {
       size_t n = std::fread(block.data(), 1, block.size(), f);
       if (n == 0) break;
-      send_prefix(n);
+      send_grpc_prefix(c, n);
       c.send_flow_controlled(block.data(), n, false);
     }
   }
@@ -333,15 +474,11 @@ int main(int argc, char** argv) {
 
   while (!c.done) c.pump();
 
-  if (c.response.size() < 5) die("no gRPC response message");
-  if (c.response[0] != 0) die("compressed response unsupported");
-  uint32_t mlen = (static_cast<uint8_t>(c.response[1]) << 24) |
-                  (static_cast<uint8_t>(c.response[2]) << 16) |
-                  (static_cast<uint8_t>(c.response[3]) << 8) |
-                  static_cast<uint8_t>(c.response[4]);
-  if (c.response.size() < 5 + static_cast<size_t>(mlen))
-    die("truncated gRPC response message");
-  std::fwrite(c.response.data() + 5, 1, mlen, stdout);
+  size_t consumed = 0;
+  std::string msg;
+  if (!next_message(c.response, &consumed, &msg))
+    die("no (or truncated) gRPC response message");
+  std::fwrite(msg.data(), 1, msg.size(), stdout);
   std::fputc('\n', stdout);
   ::close(c.fd);
   return 0;

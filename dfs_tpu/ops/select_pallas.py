@@ -5,11 +5,12 @@ whose per-step work is trivial but whose per-step overhead is not: even
 unrolled 8-wide it measures ~1.0-1.6 ms per 64 MiB region on v5e —
 second only to the SHA scan in the chain profile, for what is
 fundamentally ~683 * ~50 vector-lane operations. This kernel runs the
-whole walk inside ONE Pallas program: the anchor-tile array DMAs into
-VMEM once (~0.5 MB), each step reads a 16x128 block around its
-selection window (8-row aligned, the Mosaic sublane-slice granularity)
-and takes a masked max, and the boundary list accumulates in registers
-via an iota select — no dynamic lane stores, no per-step dispatch.
+whole walk inside ONE Pallas program: the two anchor-tile planes DMA
+into VMEM once (~1 MB), each step reads a 16x128 block from each plane
+around its selection window (8-row aligned, the Mosaic sublane-slice
+granularity) and takes a masked max over their union, and the boundary
+list accumulates in registers via an iota select — no dynamic lane
+stores, no per-step dispatch.
 
 Semantics are bit-identical to make_select_fn (the equality tests pin
 both, and make_chain_fn only uses this path on TPU after the shapes
@@ -40,9 +41,9 @@ _WIN_ROWS = 16         # 8-row-aligned window start => off < 1024, and
 
 def select_window_tiles(params) -> int:
     """Selection-window width in tiles — THE single definition (the XLA
-    scan, this kernel, and the support gate all call it, so a future
-    window change — e.g. the recorded two-anchors-per-tile pickup —
-    cannot desynchronize them)."""
+    scan, this kernel, and the support gate all call it, so a window
+    change cannot desynchronize them). With two kept anchors per tile
+    the window is this many tiles from each of the two planes."""
     from dfs_tpu.ops.cdc_anchored import TILE_BYTES
 
     return (params.seg_max - params.seg_min) // TILE_BYTES + 1
@@ -60,8 +61,11 @@ def select_pallas_supported(params) -> bool:
 @functools.cache
 def make_select_fn_pallas(params, m_tiles: int, cap: int,
                           interpret: bool = False):
-    """Compiled: (tiles [m_tiles] i32, start0 i32, n i32, final bool) ->
-    bounds [cap] i32 — drop-in twin of make_select_fn."""
+    """Compiled: (tiles [2, m_tiles] i32, start0 i32, n i32, final bool)
+    -> bounds [cap] i32 — drop-in twin of make_select_fn. The two anchor
+    planes (first/second kept anchor per tile) are stacked row-wise in
+    one VMEM scratch; each step reads the same-aligned [16, 128] block
+    from both planes and the masked max runs over their union."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -76,7 +80,7 @@ def make_select_fn_pallas(params, m_tiles: int, cap: int,
     t0_max = m_tiles + seg_min // TILE_BYTES + 1
     need = t0_max + win + _WIN_ROWS * 128 + _ROW_TILE * 128
     m_pad = -(-need // 1024) * 1024
-    rows = m_pad // 128
+    rows = m_pad // 128        # multiple of 8: plane 1 stays row-aligned
     cap_pad = -(-cap // 128) * 128
 
     def kernel(scal_ref, tiles_hbm, out_ref, tiles_vmem, sem):
@@ -98,12 +102,14 @@ def make_select_fn_pallas(params, m_tiles: int, cap: int,
             t0 = (lo - 1) // TILE_BYTES
             r0 = (t0 // 128 // _ROW_TILE) * _ROW_TILE
             r0 = pl.multiple_of(r0, _ROW_TILE)
-            block = tiles_vmem[pl.ds(r0, _WIN_ROWS), :]
+            r1 = pl.multiple_of(r0 + rows, _ROW_TILE)
             g = (row + r0) * 128 + col            # global tile index
-            val = block
-            ok = (g >= t0) & (g <= t0 + (win - 1)) \
-                & (val >= lo - 1) & (val <= hi - 1)
-            last = jnp.max(jnp.where(ok, val, -1))
+            in_win = (g >= t0) & (g <= t0 + (win - 1))
+            last = jnp.int32(-1)
+            for rr in (r0, r1):                   # first, second plane
+                val = tiles_vmem[pl.ds(rr, _WIN_ROWS), :]
+                ok = in_win & (val >= lo - 1) & (val <= hi - 1)
+                last = jnp.maximum(last, jnp.max(jnp.where(ok, val, -1)))
             b = jnp.where(last >= 0, last + 1, hi)
             fin = (n - start <= seg_max).astype(jnp.int32)
             b = jnp.where(fin == 1, n, b)
@@ -124,15 +130,15 @@ def make_select_fn_pallas(params, m_tiles: int, cap: int,
         grid=(1,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        scratch_shapes=[pltpu.VMEM((rows, 128), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((2 * rows, 128), jnp.int32),
                         pltpu.SemaphoreType.DMA],
     )
 
     @jax.jit
     def run(tiles, start0, n, final):
         tiles_p = jnp.concatenate(
-            [tiles, jnp.full((m_pad - m_tiles,), 2**30, jnp.int32)]
-        ).reshape(rows, 128)
+            [tiles, jnp.full((2, m_pad - m_tiles), 2**30, jnp.int32)],
+            axis=1).reshape(2 * rows, 128)
         scal = jnp.stack([start0.astype(jnp.int32),
                           jnp.int32(n),
                           final.astype(jnp.int32)])

@@ -157,8 +157,9 @@ def make_anchored_anchor_step(mesh: Mesh, params, m_local: int):
     no collective is needed at all (the halo is baked into each device's
     span, the anchored analogue of the rolling pipeline's ppermute ring).
 
-    step(spans [n_dev, 2 + m_local] u32) -> tiles [n_dev * tiles_local]
-    i32 (first-anchor byte position per TILE_BYTES tile, region-local).
+    step(spans [n_dev, 2 + m_local] u32) -> tiles
+    [2, n_dev * tiles_local] i32 (first-two-anchor byte positions per
+    TILE_BYTES tile, region-local; row 0 < row 1 where present).
     """
     from dfs_tpu.ops.cdc_anchored import TILE_BYTES, make_anchor_fn
 
@@ -170,19 +171,20 @@ def make_anchored_anchor_step(mesh: Mesh, params, m_local: int):
         # the span — rebase to region offsets with the device index.
         dev = jax.lax.axis_index("dp") * mesh.shape["sp"] \
             + jax.lax.axis_index("sp")
-        tiles = local_fn(span[0])
+        tiles = local_fn(span[0])                   # [2, tiles_local]
         return (tiles + jnp.where(tiles < 2**30,
                                   dev * jnp.int32(m_local * 4),
-                                  0))[None, :]
+                                  0))[None, :, :]
 
     shard_fn = jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(P(("dp", "sp"), None),),
-        out_specs=P(("dp", "sp"), None),
+        out_specs=P(("dp", "sp"), None, None),
         check_vma=False,
     )
-    return jax.jit(lambda spans: shard_fn(spans).reshape(
-        mesh.devices.size * tiles_local))
+    return jax.jit(lambda spans: jnp.swapaxes(
+        shard_fn(spans), 0, 1).reshape(
+        2, mesh.devices.size * tiles_local))
 
 
 def shard_anchor_inputs(mesh: Mesh, words: np.ndarray, m_local: int):
@@ -334,9 +336,11 @@ def anchored_sharded_parity_check(mesh: Mesh, n_devices: int) -> None:
     astep = make_anchored_anchor_step(mesh, params, m_local)
     tiles = np.asarray(astep(shard_anchor_inputs(mesh, words, m_local)))
     kept = kept_anchors_np(data, params)
-    expect_tiles = np.full((m_words * 4 // TILE_BYTES,), 2**30, np.int32)
-    for p in kept:                     # kept is first-per-tile already
-        expect_tiles[int(p) // TILE_BYTES] = int(p)
+    expect_tiles = np.full((2, m_words * 4 // TILE_BYTES), 2**30, np.int32)
+    for p in kept:                  # kept is first-two-per-tile, sorted
+        t = int(p) // TILE_BYTES
+        row = 0 if expect_tiles[0, t] == 2**30 else 1
+        expect_tiles[row, t] = int(p)
     if not np.array_equal(tiles, expect_tiles):
         raise AssertionError("sharded anchored pass A tile mismatch")
 

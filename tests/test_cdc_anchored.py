@@ -39,12 +39,23 @@ def test_anchor_hash_window_is_8_bytes():
     assert anchor_hash_np(d3, SMALL)[p] != h[p]
 
 
-def test_kept_anchors_one_per_tile():
+def test_kept_anchors_two_per_tile():
     data = corpus(200000, seed=2)
     kept = kept_anchors_np(data, SMALL)
     tiles = kept // TILE_BYTES
-    assert len(set(tiles.tolist())) == len(kept)
+    counts = np.bincount(tiles)
+    assert counts.max() <= 2
     assert np.all(np.diff(kept) > 0)
+    # the rule keeps the FIRST two of each tile: every kept pair must be
+    # the two smallest qualifying positions of its tile
+    from dfs_tpu.ops.cdc_anchored import anchor_hash_np
+    hit = (anchor_hash_np(data, SMALL) & np.uint32(SMALL.seg_mask)) == 0
+    pos = np.flatnonzero(hit)
+    for t in np.unique(tiles):
+        in_tile = pos[pos // TILE_BYTES == t]
+        expect = in_tile[:2]
+        got = kept[tiles == t]
+        assert np.array_equal(got, expect)
 
 
 def test_segments_respect_bounds():
@@ -470,6 +481,22 @@ def test_tight_segment_lane_overflow_in_pipelined_walk(monkeypatch):
         A.make_chain_fn.cache_clear()
 
 
+def _random_two_plane_tiles(rng, m_tiles, density=2):
+    """Random pass-A-shaped [2, m_tiles] tile planes: ~1/density tiles
+    hold a first anchor, about half of those also a second (strictly
+    larger, same tile) — mirrors make_anchor_fn's output invariants."""
+    tiles = np.full((2, m_tiles), 2**30, np.int32)
+    k = max(1, m_tiles // density)
+    idx = rng.choice(m_tiles, size=k, replace=False)
+    off1 = rng.integers(0, TILE_BYTES - 1, size=k)   # <= TILE_BYTES - 2
+    tiles[0, idx] = (idx * TILE_BYTES + off1).astype(np.int32)
+    has2 = rng.random(k) < 0.5
+    off2 = off1 + 1 + rng.integers(0, TILE_BYTES - 1 - off1)
+    tiles[1, idx[has2]] = (idx[has2] * TILE_BYTES
+                           + off2[has2]).astype(np.int32)
+    return tiles
+
+
 def test_pallas_select_matches_xla_scan():
     """The on-core Pallas selection walk (ops.select_pallas) must agree
     with the XLA scan bit-for-bit: random anchor-tile patterns, final
@@ -486,12 +513,7 @@ def test_pallas_select_matches_xla_scan():
         n = int(rng.integers(20000, 120000))
         m_tiles = 1 << (-(-n // TILE_BYTES) - 1).bit_length()
         cap = m_tiles * TILE_BYTES // params.seg_min + 1
-        tiles = np.full(m_tiles, 2**30, np.int32)
-        k = int(rng.integers(1, m_tiles))
-        idx = rng.choice(m_tiles, size=k, replace=False)
-        tiles[idx] = (idx * TILE_BYTES
-                      + rng.integers(0, TILE_BYTES, size=k)
-                      ).astype(np.int32)
+        tiles = _random_two_plane_tiles(rng, m_tiles)
         import dfs_tpu.ops.cdc_anchored as A
         for final in (True, False):
             for start0 in (0, 1234):
@@ -522,11 +544,7 @@ def test_pallas_select_large_region_block_addressing():
     m_tiles = n // TILE_BYTES           # 8192 tiles -> t0 up to ~8192
     cap = n // params.seg_min + 1
     rng = np.random.default_rng(12)
-    tiles = np.full(m_tiles, 2**30, np.int32)
-    idx = rng.choice(m_tiles, size=m_tiles // 16, replace=False)
-    tiles[idx] = (idx * TILE_BYTES
-                  + rng.integers(0, TILE_BYTES, size=idx.size)
-                  ).astype(np.int32)
+    tiles = _random_two_plane_tiles(rng, m_tiles, density=16)
     for final in (True, False):
         ref = A.make_select_fn(params, m_tiles, cap)(
             jnp.asarray(tiles), jnp.int32(0), jnp.int32(n),

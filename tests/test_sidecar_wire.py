@@ -100,3 +100,42 @@ def test_cpp_client_health_and_unary_methods(tmp_path, rng, sidecar):
     assert [(g["offset"], g["length"], g["digest"])
             for g in table["chunks"]] \
         == [(r.offset, r.length, r.digest) for r in want]
+
+
+def test_cpp_client_duplex_streams_batches(tmp_path, rng, sidecar):
+    """ChunkHashDuplex from the library-less client — the method a
+    teeing storage node actually uses, with the deadlock-relevant
+    window rule: the client first fetches Health's reporting-lag
+    ``window`` and never lets more than 2x that many un-reported bytes
+    into flight, exactly like SidecarFragmenter.chunks_stream. If the
+    sidecar's real lag exceeded its advertised bound, this client
+    would stall at the cap and die on its 60 s socket timeout — so a
+    green run IS the conformance proof for the window contract. Output
+    is JSONL: chunk batches as the walk finalizes them, then the done
+    message; merged chunks must match the CPU oracle byte for byte."""
+    binary = build_sidecar_client()
+    assert binary is not None
+
+    data = rng.integers(0, 256, size=3_000_000, dtype=np.uint8).tobytes()
+    payload = tmp_path / "dup.bin"
+    payload.write_bytes(data)
+
+    out = subprocess.run(
+        [str(binary), "127.0.0.1", str(sidecar.port), str(payload),
+         "ChunkHashDuplex"], capture_output=True, timeout=120)
+    assert out.returncode == 0, out.stderr.decode()
+    lines = [json.loads(ln) for ln in out.stdout.splitlines() if ln.strip()]
+    assert len(lines) >= 2, "expected streamed batches plus a done message"
+    *batches, done = lines
+    assert done["done"] is True and done["size"] == len(data)
+    assert all("chunks" in b for b in batches)
+    # the window bound must be real for the cap to have been exercised
+    assert (sidecar.fragmenter.stream_span() or 0) > 0
+    merged = [c for b in batches for c in b["chunks"]]
+    want = sidecar.fragmenter.chunk(data)
+    assert [(g["offset"], g["length"], g["digest"]) for g in merged] \
+        == [(r.offset, r.length, r.digest) for r in want]
+    # file id in the done message matches the digest-derived id
+    from dfs_tpu.ops.cdc_v2 import file_id_from_digests
+    assert done["fileId"] == file_id_from_digests(
+        [r.digest for r in want])

@@ -16,7 +16,12 @@ window k+1 can be device_put while window k computes; the carry position
 chains as a DEVICE scalar (consumed_k - stride), so a multi-region stream
 runs with zero host syncs until results are collected. This is the
 host->HBM staging overlap the reference's synchronous upload loop
-(StorageNode.java:118-189) has no analogue of.
+(StorageNode.java:118-189) has no analogue of. Overlap is ADAPTIVE:
+the walk measures its own staging bandwidth and serializes transfers
+when the link is slow — concurrent 64 MiB puts on a slow shared tunnel
+measured 2-4x WORSE than strictly serial ones (E2E_r05.json), while
+overlap only pays at all when the transfer time approaches the ~6 ms
+chain compute (see AnchoredTpuFragmenter.__init__).
 
 - ``AnchoredCpuFragmenter`` — NumPy oracle path (chunk_file_anchored_np).
 - ``AnchoredTpuFragmenter`` — full device pipeline, bounded-memory
@@ -39,6 +44,21 @@ from dfs_tpu.ops.cdc_v2 import file_id_from_digests
 
 _REGION_BYTES = 64 * 1024 * 1024
 _CPU_CUTOFF = 2 * 1024 * 1024
+_REMEASURE_EVERY = 8     # overlapped mode re-times every Nth transfer
+
+
+_touch_fn = None
+
+
+def _touch(words):
+    """A one-element jitted read whose readiness proves the buffer's
+    host->device transfer actually finished (see _dispatch_window)."""
+    global _touch_fn
+    if _touch_fn is None:
+        import jax
+
+        _touch_fn = jax.jit(lambda w: w[0])
+    return _touch_fn(words)
 
 
 def _to_u8(data) -> np.ndarray:
@@ -204,7 +224,8 @@ class AnchoredTpuFragmenter(_AnchoredBase):
                  region_bytes: int = _REGION_BYTES,
                  cpu_cutoff: int = _CPU_CUTOFF,
                  lane_multiple: int = 128,
-                 max_inflight: int = 2) -> None:
+                 max_inflight: int = 2,
+                 overlap_min_bw: float = float(1 << 30)) -> None:
         super().__init__(params)
         region_bytes = (int(region_bytes) // TILE_BYTES) * TILE_BYTES
         if region_bytes < 2 * self.params.seg_max:
@@ -221,6 +242,32 @@ class AnchoredTpuFragmenter(_AnchoredBase):
         # buffer on some host->device links; a buffer returns to the pool
         # at collect time, when its transfer has certainly completed
         self._buf_pool: dict[int, list[np.ndarray]] = {}
+        # Adaptive staging serialization. Overlapping window k+1's
+        # device_put with window k's compute only pays when the transfer
+        # is not much slower than the ~6 ms chain — and on a slow shared
+        # tunnel CONCURRENT big transfers measured 2-4x WORSE than
+        # strictly serial ones (256 MiB walk: 5-15 MiB/s pipelined vs
+        # 22-26 serial on a ~25 MiB/s link — the A/B is in
+        # E2E_r05.json). So the walk measures its own staging bandwidth
+        # (a block_until_ready around the put, which IS the
+        # serialization) and only overlaps while the link has proven
+        # faster than ``overlap_min_bw``; in overlapped mode every 8th
+        # window is re-measured so a degrading link flips the walk back
+        # to serial within one region batch.
+        self.overlap_min_bw = float(overlap_min_bw)
+        self._staging_bw: float | None = None
+        self._since_measure = _REMEASURE_EVERY  # first window measures
+        # (bytes, seconds) of recent measured window transfers — the
+        # walk's own record of the link it actually had, which is the
+        # only bandwidth number honestly comparable to its e2e rate on
+        # a tunnel that swings 50x on minute timescales (bench_e2e_stream
+        # reads this; see staging_observed_bw). Bounded: a long-lived
+        # node on a slow link measures every window forever, and a
+        # lifetime average would mix samples hours apart.
+        import collections
+
+        self._staging_samples: collections.deque[tuple[int, float]] = \
+            collections.deque(maxlen=64)
 
     # -- pipelined region walk shared by chunk() and manifest_stream() ----
 
@@ -245,6 +292,29 @@ class AnchoredTpuFragmenter(_AnchoredBase):
         staged = region_buffer(fetch(base, end - base), lookback,
                                self.params, out=self._pool_take(end - base))
         words = jax.device_put(staged)
+        # adaptive staging serialization (see __init__): wait for this
+        # transfer to REALLY complete (and time it) unless the link has
+        # recently proven fast enough that overlapping transfers is a
+        # win rather than a tunnel pile-up. The wait goes through a
+        # tiny jitted read of the buffer, NOT block_until_ready on the
+        # put result: on the tunneled backend the put is deferred until
+        # first use, so block_until_ready returns immediately (a bogus
+        # 19 GB/s 'measurement' in the A/B that motivated this —
+        # E2E_r05.json) and serializes nothing.
+        measure = (self._staging_bw is None
+                   or self._staging_bw < self.overlap_min_bw
+                   or self._since_measure >= _REMEASURE_EVERY)
+        if measure:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            jax.block_until_ready(_touch(words))
+            dt = max(_time.perf_counter() - t0, 1e-9)
+            self._staging_bw = staged.nbytes / dt
+            self._since_measure = 0
+            self._staging_samples.append((staged.nbytes, dt))
+        else:
+            self._since_measure += 1
         out = region_dispatch(words, end - base, start0, final,
                               self.params, lane_multiple=self.lane_multiple)
         return base, end, final, out, staged
@@ -302,10 +372,24 @@ class AnchoredTpuFragmenter(_AnchoredBase):
                 store(dg, fetch(off, ln).tobytes())
         return base + consumed
 
+    def staging_observed_bw(self) -> float | None:
+        """Aggregate bandwidth of the recent transfers the walk timed
+        (up to the deque bound — the same-run link number its e2e rate
+        is honestly comparable to); None before any walk. Callers may
+        ``_staging_samples.clear()`` to scope the aggregate to one
+        run, as bench_e2e_stream does."""
+        if not self._staging_samples:
+            return None
+        return (sum(b for b, _ in self._staging_samples)
+                / sum(t for _, t in self._staging_samples))
+
     def _walk(self, arr: np.ndarray, store=None) -> list[ChunkRef]:
         n = int(arr.shape[0])
         if n == 0:
             return []
+        self._since_measure = _REMEASURE_EVERY  # re-time on window 0:
+        # a stale fast estimate from a previous walk must not leave
+        # this one overlapped on a link that has since collapsed
         if n <= self.cpu_cutoff:
             spans = chunk_file_anchored_np(arr, self.params)
             out = [ChunkRef(index=i, offset=o, length=ln, digest=dg)
@@ -364,6 +448,7 @@ class AnchoredTpuFragmenter(_AnchoredBase):
         start0 = 0
         base = 0
         done = False
+        self._since_measure = _REMEASURE_EVERY  # see _walk
 
         def fetch(off: int, ln: int) -> np.ndarray:
             if off < buf_base:

@@ -393,8 +393,12 @@ int run_duplex(const std::string& host, const std::string& port,
     sent += static_cast<int64_t>(n);
     drain();  // send_flow_controlled may have pumped response frames
   }
-  std::string fin = frame(kData, kEndStream, 1, "");
-  write_all(c.fd, fin.data(), fin.size());
+  if (!c.done) {  // half-close only a live stream: after an early
+    // server END_STREAM (+ closed TCP) the send would SIGPIPE and
+    // mask the loud no-done-message diagnostic below
+    std::string fin = frame(kData, kEndStream, 1, "");
+    write_all(c.fd, fin.data(), fin.size());
+  }
   while (!c.done) {
     c.pump();
     drain();

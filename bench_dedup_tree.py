@@ -1,0 +1,172 @@
+"""Dedup on a FILE-TREE-shaped corpus — BASELINE.json configs[3]'s real
+workload shape ("Linux-kernel source snapshots v6.1..v6.6"): thousands
+of small source files tarred per version, with edits that INSERT and
+DELETE lines, whole-file additions/removals, and renames — not the
+single uniform-churn blob `bench_dedup.py` uses. Anchor re-sync is
+stressed the way the named workload actually stresses it: every edited
+file shifts all downstream tar content by an unaligned delta, and file
+adds/removes/renames shift whole 512-byte tar record runs.
+
+Prints ONE JSON line:
+    {"metric": "dedup_ratio_tree_corpus_anchored", "value": N,
+     "unit": "logical/physical", "vs_baseline": N}
+vs_baseline: anchored ratio / 1.0 (the fixed-N reference dedups ~1.0x).
+Comparisons (aligned v2, byte-granular rolling) go to stderr and the
+committed artifact.
+
+Usage: python bench_dedup_tree.py [n_files] [n_versions] [mean_file_bytes]
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import sys
+import tarfile
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+_WORDS = None
+
+
+def _line(rng, width: int = 60) -> bytes:
+    """Source-ish text line: identifier-shaped tokens, stable dictionary
+    so repeated lines across files/versions dedup like real code."""
+    global _WORDS
+    if _WORDS is None:
+        wrng = np.random.default_rng(99)
+        _WORDS = [bytes(wrng.integers(97, 123, size=int(n)).tolist())
+                  for n in wrng.integers(3, 12, size=4096)]
+    k = rng.integers(2, 9)
+    toks = [
+        _WORDS[int(i)] for i in rng.integers(0, len(_WORDS), size=int(k))]
+    return b" ".join(toks)[:width] + b"\n"
+
+
+def make_tree(rng, n_files: int, mean_file_bytes: int):
+    """{path: list-of-lines} — a synthetic source tree."""
+    tree = {}
+    for i in range(n_files):
+        nbytes = max(256, int(rng.exponential(mean_file_bytes)))
+        lines = []
+        sz = 0
+        while sz < nbytes:
+            ln = _line(rng)
+            lines.append(ln)
+            sz += len(ln)
+        d1, d2 = int(rng.integers(0, 12)), int(rng.integers(0, 8))
+        tree[f"src/d{d1:02d}/m{d2}/f{i:05d}.c"] = lines
+    return tree
+
+
+def evolve(rng, tree: dict, churn: float = 0.04) -> dict:
+    """One 'release': edit ~churn of files (insert AND delete lines),
+    add/remove a few files, rename a few (content unchanged)."""
+    out = dict(tree)
+    paths = list(out.keys())
+    n_edit = max(1, int(len(paths) * churn))
+    for p in rng.choice(paths, size=n_edit, replace=False):
+        lines = list(out[p])
+        for _ in range(int(rng.integers(1, 6))):
+            at = int(rng.integers(0, max(1, len(lines))))
+            op = int(rng.integers(0, 3))
+            if op == 0:                          # insert a few lines
+                for j in range(int(rng.integers(1, 4))):
+                    lines.insert(at + j, _line(rng))
+            elif op == 1 and len(lines) > 3:     # delete a few lines
+                del lines[at:at + int(rng.integers(1, 4))]
+            else:                                # modify one line
+                if lines:
+                    lines[at % len(lines)] = _line(rng)
+        out[p] = lines
+    # whole-file adds and removes (~churn/4 each)
+    for p in rng.choice(paths, size=max(1, n_edit // 4), replace=False):
+        out.pop(p, None)
+    base = max(int(p.split("f")[-1].split(".")[0])
+               for p in out if "f" in p) + 1
+    for j in range(max(1, n_edit // 4)):
+        d1, d2 = int(rng.integers(0, 12)), int(rng.integers(0, 8))
+        nf = make_tree(rng, 1, 4096)
+        out[f"src/d{d1:02d}/m{d2}/f{base + j:05d}.c"] = \
+            next(iter(nf.values()))
+    # renames (content identical — pure path shift in the tar)
+    paths = list(out.keys())
+    for p in rng.choice(paths, size=max(1, n_edit // 6), replace=False):
+        if p in out:
+            out[p.replace("/m", "/r")] = out.pop(p)
+    return out
+
+
+def tar_bytes(tree: dict) -> bytes:
+    """Deterministic uncompressed tar (sorted paths, zeroed metadata) —
+    the 'snapshot' artifact each version uploads."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) \
+            as tf:
+        for p in sorted(tree):
+            body = b"".join(tree[p])
+            info = tarfile.TarInfo(name=p)
+            info.size = len(body)
+            info.mtime = 0
+            tf.addfile(info, io.BytesIO(body))
+    return buf.getvalue()
+
+
+def main() -> int:
+    n_files = int(sys.argv[1]) if len(sys.argv) > 1 else 4000
+    n_versions = int(sys.argv[2]) if len(sys.argv) > 2 else 6
+    mean_file = int(sys.argv[3]) if len(sys.argv) > 3 else 12 * 1024
+
+    from dfs_tpu.config import CDCParams
+    from dfs_tpu.fragmenter.cdc_aligned import AlignedCpuFragmenter
+    from dfs_tpu.fragmenter.cdc_anchored import AnchoredCpuFragmenter
+    from dfs_tpu.fragmenter.cdc_cpu import CpuCdcFragmenter
+
+    rng = np.random.default_rng(17)
+    tree = make_tree(rng, n_files, mean_file)
+    versions = []
+    for v in range(n_versions):
+        versions.append(tar_bytes(tree))
+        log(f"version {v}: {len(versions[-1]) / 2**20:.1f} MiB tar, "
+            f"{len(tree)} files")
+        if v + 1 < n_versions:
+            tree = evolve(rng, tree)
+
+    def ratio_for(frag) -> float:
+        logical = 0
+        stored: dict[str, int] = {}
+        for i, blob in enumerate(versions):
+            logical += len(blob)
+            new = 0
+            for c in frag.chunk(blob):
+                if c.digest not in stored:
+                    stored[c.digest] = c.length
+                    new += c.length
+            log(f"[{frag.name}] v{i}: new {new / 2**20:.2f} MiB")
+        return logical / sum(stored.values())
+
+    anchored = ratio_for(AnchoredCpuFragmenter())
+    aligned = ratio_for(AlignedCpuFragmenter())
+    rolling = ratio_for(CpuCdcFragmenter(CDCParams()))
+    log(f"tree corpus: anchored {anchored:.3f}x vs aligned {aligned:.3f}x "
+        f"vs rolling {rolling:.3f}x "
+        f"({100 * anchored / rolling:.1f}% of byte-granular)")
+    print(json.dumps({
+        "metric": "dedup_ratio_tree_corpus_anchored",
+        "value": round(anchored, 3),
+        "unit": "logical/physical",
+        "vs_baseline": round(anchored, 3),
+        "comparisons": {"aligned_v2": round(aligned, 3),
+                        "rolling_byte_granular": round(rolling, 3)},
+        "pct_of_byte_granular": round(100 * anchored / rolling, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

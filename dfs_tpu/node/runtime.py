@@ -1185,6 +1185,12 @@ class StorageNodeServer:
                 b = have.get(d)
             if b is None or len(b) != ln:
                 return None
+            if ln == shard_len:
+                # common case (every shard except a stripe's tail):
+                # zero-copy view — recover_stripes only reads its
+                # inputs, and the padded-copy here measured a full
+                # extra pass over the corpus per degraded read
+                return np.frombuffer(b, dtype=np.uint8)
             arr = np.zeros(shard_len, dtype=np.uint8)
             arr[:ln] = np.frombuffer(b, dtype=np.uint8)
             return arr

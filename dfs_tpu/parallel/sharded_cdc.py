@@ -374,6 +374,106 @@ def anchored_sharded_parity_check(mesh: Mesh, n_devices: int) -> None:
         raise AssertionError("anchored sharded spans != oracle spans")
 
 
+def anchored_sharded_production_check(mesh: Mesh, n_devices: int,
+                                      region_bytes: int = 64 * 2**20,
+                                      ) -> dict:
+    """The parity check above at PRODUCTION geometry: a full 64 MiB
+    region, default AnchoredCdcParams (96-128 KiB segments, 128 KiB
+    lanes), lane tables padded to lane_multiple=128 — the exact shapes
+    the single-chip chain ships with (`__graft_entry__.entry` uses
+    production lane_multiple but toy segments; the toy-mesh check uses
+    4-tile devices). This exercises what those cannot: lane-table
+    provisioning at ~640 real lanes, halo/rebase correctness at 16K
+    tiles per device, and the [2, n_tiles] two-anchor planes across
+    device boundaries. Oracle-checked end to end (pass-A tiles, pass-B
+    cutflags per segment, psum, reconstructed spans == whole-stream
+    oracle). Returns a timing/shape record for the committed artifact
+    (wall times; on a virtual CPU mesh all devices share the host, so
+    per-step wall time is the honest number — per-device counters would
+    fabricate parallelism the harness does not have)."""
+    import time
+
+    from dfs_tpu.ops.cdc_anchored import (TILE_BYTES, AnchoredCdcParams,
+                                          chunk_spans_anchored_np,
+                                          kept_anchors_np, region_buffer)
+    from dfs_tpu.ops.cdc_v2 import BLOCK
+
+    params = AnchoredCdcParams()               # production geometry
+    lane_multiple = 128
+    n = (region_bytes // TILE_BYTES) * TILE_BYTES
+    m_words = n // 4
+    if m_words % n_devices:
+        raise ValueError("region words must split evenly over devices")
+    m_local = m_words // n_devices
+    if (m_local * 4) % TILE_BYTES:
+        raise ValueError("per-device span must be tile-aligned")
+
+    rng = np.random.default_rng(23)
+    data = rng.integers(0, 256, size=n, dtype=np.uint8)
+    words = np.asarray(region_buffer(data, np.zeros((8,), np.uint8),
+                                     params, m_words=m_words))
+    rec: dict = {"region_bytes": n, "n_devices": n_devices,
+                 "m_local_words": m_local,
+                 "tiles_per_device": m_local * 4 // TILE_BYTES,
+                 "params": {"seg_min": params.seg_min,
+                            "seg_max": params.seg_max,
+                            "strip_blocks": params.chunk.strip_blocks,
+                            "lane_multiple": lane_multiple}}
+
+    # ---- pass A sharded at production scale ----
+    astep = make_anchored_anchor_step(mesh, params, m_local)
+    inp = shard_anchor_inputs(mesh, words, m_local)
+    t0 = time.perf_counter()
+    tiles = np.asarray(jax.block_until_ready(astep(inp)))
+    rec["pass_a_s"] = round(time.perf_counter() - t0, 3)
+    kept = kept_anchors_np(data, params)
+    expect_tiles = np.full((2, m_words * 4 // TILE_BYTES), 2**30, np.int32)
+    for p in kept:
+        t = int(p) // TILE_BYTES
+        row = 0 if expect_tiles[0, t] == 2**30 else 1
+        expect_tiles[row, t] = int(p)
+    if not np.array_equal(tiles, expect_tiles):
+        raise AssertionError("production sharded pass A tile mismatch")
+    rec["kept_anchors"] = int(kept.shape[0])
+
+    # ---- host selection + production lane tables ----
+    (starts, bounds, seg_lens, w_off, sh8, real_blocks,
+     s_real) = host_lane_descriptors(data, params, lane_multiple)
+    if w_off.shape[0] % n_devices:
+        raise AssertionError(
+            f"lane table {w_off.shape[0]} not divisible by {n_devices}")
+    rec["segments"] = int(s_real)
+    rec["lane_table"] = int(w_off.shape[0])
+
+    # ---- pass B sharded at production scale ----
+    bstep = make_anchored_step(mesh, params)
+    binp = shard_anchored_inputs(mesh, words, w_off, sh8, real_blocks)
+    t0 = time.perf_counter()
+    cf, since, _states, n_chunks = jax.block_until_ready(bstep(*binp))
+    rec["pass_b_s"] = round(time.perf_counter() - t0, 3)
+    cf = np.asarray(cf)
+    expect = expected_segment_cutflags(data, starts, bounds, params)
+    if not np.array_equal(cf[:, :s_real], expect):
+        raise AssertionError("production sharded cutflag mismatch")
+    if int(n_chunks) != int(cf.sum()):
+        raise AssertionError("production sharded psum mismatch")
+    rec["chunks"] = int(n_chunks)
+
+    # ---- end-to-end span parity vs the whole-stream oracle ----
+    spans = []
+    for i in range(s_real):
+        ln = int(seg_lens[i])
+        cuts = np.flatnonzero(cf[:, i]) + 1
+        prev = 0
+        for c in cuts.tolist():
+            end = min(c * BLOCK, ln)
+            spans.append((int(starts[i]) + prev * BLOCK, end - prev * BLOCK))
+            prev = c
+    if spans != chunk_spans_anchored_np(data, params):
+        raise AssertionError("production sharded spans != oracle spans")
+    return rec
+
+
 # ---------------------------------------------------------------------------
 # erasure parity, sharded — stripes are independent; pure data parallelism
 # ---------------------------------------------------------------------------

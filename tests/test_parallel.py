@@ -4,6 +4,7 @@ sharded results must equal single-device results exactly."""
 import hashlib
 
 import numpy as np
+import pytest
 
 from dfs_tpu.config import CDCParams
 from dfs_tpu.fragmenter.cdc_cpu import gear_bitmap_numpy
@@ -94,3 +95,21 @@ def test_sharded_ec_step_matches_oracle():
         assert np.array_equal(p[s], p0), s
         assert np.array_equal(q[s], q0), s
     assert int(nbytes) == 2 * ns * ln
+
+
+@pytest.mark.slow
+def test_anchored_sharded_production_geometry():
+    """The sharded anchored step at PRODUCTION shapes — a full 64 MiB
+    region, default params, lane_multiple=128 — over the 8-device mesh,
+    oracle-checked end to end (VERDICT r4 #4: the toy-shape checks
+    leave lane provisioning, halo correctness at real tile counts, and
+    the two-anchor planes across device boundaries unverified). The
+    fast CI tier keeps the toy shapes; the committed artifact of this
+    run is MULTICHIP_SCALE_r05.json (run_multichip_scale.py)."""
+    from dfs_tpu.parallel.mesh import make_mesh
+    from dfs_tpu.parallel.sharded_cdc import (
+        anchored_sharded_production_check)
+
+    rec = anchored_sharded_production_check(make_mesh(8), 8)
+    assert rec["chunks"] > 5000
+    assert rec["segments"] >= 500

@@ -107,7 +107,7 @@ def main() -> int:
     tpu = AnchoredTpuFragmenter()
     run(tpu, warm)                               # compile + warm transfers
     link_before = probe_link()
-    tpu._staging_samples.clear()                 # scope to the timed run
+    tpu.reset_staging_samples()                  # scope to the timed run
     tpu_dt, n = run(tpu, blocks)
     observed = tpu.staging_observed_bw() or 0.0  # the link the walk HAD:
     # its own timed window transfers, concurrent with the run — the only
@@ -115,10 +115,11 @@ def main() -> int:
     # (bracket probes taken seconds away routinely disagree 3-5x)
     link_after = probe_link()
     tpu_gibps = total / tpu_dt / 2**30
+    timed_windows = tpu.staging_timed_windows()
     log(f"tpu anchored (streamed): {tpu_gibps:.3f} GiB/s "
         f"({tpu_dt:.1f}s, {n} chunks); staging link: in-walk observed "
         f"{observed / 2**30:.3f} GiB/s over "
-        f"{len(tpu._staging_samples)} timed windows (bracket probes "
+        f"{timed_windows} timed windows (bracket probes "
         f"{link_before / 2**30:.3f} / {link_after / 2**30:.3f}) -> "
         f"device path at {tpu_gibps / max(observed / 2**30, 1e-9):.2f}x "
         f"its observed link")
@@ -148,7 +149,7 @@ def main() -> int:
         },
         "staging_link": {
             "in_walk_observed_gibps": round(observed / 2**30, 4),
-            "in_walk_timed_windows": len(tpu._staging_samples),
+            "in_walk_timed_windows": timed_windows,
             "probe_before_gibps": round(link_before / 2**30, 4),
             "probe_after_gibps": round(link_after / 2**30, 4),
             "probe": "region-buffer-sized fresh device_put, best of 3; "

@@ -1,0 +1,254 @@
+"""Serving-tier benchmark -> SERVE_r06.json: the hot-file read workload
+the read-path tier (dfs_tpu/serve) exists for.
+
+Four phases, all on in-process nodes with the CPU CDC engine (the tier
+is backend-agnostic; no device in the loop):
+
+1. byte-identity guard — with the DEFAULT config (tier fully off) a
+   streamed download returns bytes identical to the uploaded payload:
+   the seed read path is untouched.
+2. hot-read throughput — >= 32 concurrent readers of the same file,
+   whole-file range reads (the HTTP 206 path: per-chunk verify, no
+   whole-file re-hash), uncached (default config: every read re-reads
+   the store and re-verifies digests) vs cached (SIEVE hot-chunk cache:
+   verify once, serve many). The acceptance bar is cached >= 5x.
+3. single-flight — 32 concurrent COLD streamed readers on a cache-on
+   node: origin store reads must equal the file's unique chunk count
+   (one local read per chunk, everything else coalesced).
+4. shed curve — real HTTP GETs against a node with download_slots=S,
+   queue_depth=D: 503s must be zero while concurrency <= S+D and engage
+   beyond it.
+
+Usage: python bench_serve.py [file_bytes] [readers]
+Writes SERVE_r06.json and prints it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+
+from dfs_tpu.config import (CDCParams, ClusterConfig, NodeConfig, PeerAddr,
+                            ServeConfig)
+from dfs_tpu.node.runtime import StorageNodeServer
+
+ART = "SERVE_r06.json"
+CDC = CDCParams(min_size=2048, avg_size=8192, max_size=65536)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def one_node_cfg(root: Path, serve: ServeConfig) -> NodeConfig:
+    ports = _free_ports(2)
+    cluster = ClusterConfig(peers=(PeerAddr(
+        node_id=1, host="127.0.0.1", port=ports[0],
+        internal_port=ports[1]),), replication_factor=1)
+    return NodeConfig(node_id=1, cluster=cluster, data_root=root,
+                      fragmenter="cdc", cdc=CDC, serve=serve)
+
+
+async def hot_read_phase(node: StorageNodeServer, file_id: str,
+                         size: int, readers: int, rounds: int) -> float:
+    """Aggregate GiB/s of ``readers`` concurrent whole-file range reads
+    repeated ``rounds`` times (the HTTP 206 path: per-chunk integrity)."""
+    async def read_once() -> None:
+        _, data, _, _ = await node.download_range(file_id, 0, size - 1)
+        assert len(data) == size
+
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        await asyncio.gather(*(read_once() for _ in range(readers)))
+    dt = time.perf_counter() - t0
+    return readers * rounds * size / dt / 2**30
+
+
+async def run_phases(total: int, readers: int, tmp: Path) -> dict:
+    rng = np.random.default_rng(6)
+    data = rng.integers(0, 256, size=total, dtype=np.uint8).tobytes()
+    out: dict = {"metric": "serve_hot_read", "round": 6,
+                 "workload": {"file_bytes": total, "readers": readers,
+                              "cdc": {"min": CDC.min_size,
+                                      "avg": CDC.avg_size,
+                                      "max": CDC.max_size}}}
+
+    # ---- phase 1: default config, byte-identical streamed read ------- #
+    node = StorageNodeServer(one_node_cfg(tmp / "plain", ServeConfig()))
+    await node.start()
+    try:
+        m, _ = await node.upload(data, "hot.bin")
+        _, gen = await node.download_stream(m.file_id)
+        got = b"".join([p async for p in gen])
+        assert got == data, "default-config download not byte-identical"
+        out["default_config_byte_identical"] = True
+        out["chunks"] = m.total_chunks
+        unique = len({c.digest for c in m.chunks})
+        out["unique_chunks"] = unique
+        log(f"phase 1: default config byte-identical "
+            f"({m.total_chunks} chunks)")
+
+        # ---- phase 2a: uncached hot reads ---------------------------- #
+        await hot_read_phase(node, m.file_id, total, 4, 1)   # warm fs cache
+        uncached = await hot_read_phase(node, m.file_id, total,
+                                        readers, 3)
+        out["uncached_gibps"] = round(uncached, 4)
+        log(f"phase 2a: uncached {uncached:.3f} GiB/s aggregate")
+    finally:
+        await node.stop()
+
+    # ---- phase 2b: cached hot reads ---------------------------------- #
+    serve_on = ServeConfig(cache_bytes=max(256 * 2**20, 4 * total))
+    node = StorageNodeServer(one_node_cfg(tmp / "plain", serve_on))
+    await node.start()
+    try:
+        await hot_read_phase(node, m.file_id, total, 4, 1)   # warm cache
+        cached = await hot_read_phase(node, m.file_id, total, readers, 3)
+        cs = node.serve.cache.stats()
+        out["cached_gibps"] = round(cached, 4)
+        out["cached_speedup"] = round(cached / uncached, 3)
+        out["cache"] = {"hits": cs["hits"], "misses": cs["misses"],
+                        "bytes": cs["bytes"], "entries": cs["entries"]}
+        log(f"phase 2b: cached {cached:.3f} GiB/s aggregate "
+            f"({cached / uncached:.1f}x uncached)")
+    finally:
+        await node.stop()
+
+    # ---- phase 3: single-flight on a cold cache ---------------------- #
+    node = StorageNodeServer(one_node_cfg(tmp / "plain", serve_on))
+    await node.start()
+    try:
+        origin_reads = 0
+        store = node.store.chunks
+        orig_get = store.get
+
+        def counting_get(d):
+            nonlocal origin_reads
+            origin_reads += 1
+            return orig_get(d)
+
+        store.get = counting_get
+
+        async def stream_read() -> bytes:
+            _, gen = await node.download_stream(m.file_id)
+            return b"".join([p async for p in gen])
+
+        outs = await asyncio.gather(*(stream_read()
+                                      for _ in range(readers)))
+        assert all(o == data for o in outs)
+        fl = node.serve.flight.stats()
+        out["singleflight"] = {
+            "concurrent_cold_readers": readers,
+            "origin_reads": origin_reads,
+            "unique_chunks": unique,
+            "coalesced": fl["coalesced"],
+            "collapsed_to_unique": origin_reads == unique,
+        }
+        log(f"phase 3: {origin_reads} origin reads for {unique} unique "
+            f"chunks across {readers} cold readers "
+            f"({fl['coalesced']} coalesced)")
+        assert origin_reads == unique, "single-flight failed to collapse"
+    finally:
+        store.get = orig_get
+        await node.stop()
+
+    # ---- phase 4: shed curve over real HTTP -------------------------- #
+    slots, depth = 2, 6
+    shed_cfg = ServeConfig(cache_bytes=serve_on.cache_bytes,
+                           download_slots=slots, queue_depth=depth,
+                           retry_after_s=1.0)
+    small = data[:2 * 2**20]
+    node = StorageNodeServer(one_node_cfg(tmp / "shed", shed_cfg))
+    await node.start()
+    port = node.cfg.self_addr.port
+    try:
+        ms, _ = await node.upload(small, "shed.bin")
+        url = f"http://127.0.0.1:{port}/download?fileId={ms.file_id}"
+
+        def storm(c: int) -> tuple[int, int]:
+            """c simultaneous GETs (barrier-released threads) -> counts
+            of (200-with-full-body, 503)."""
+            barrier = threading.Barrier(c)
+            results: list[int] = []
+            lock = threading.Lock()
+
+            def one() -> None:
+                barrier.wait()
+                try:
+                    with urllib.request.urlopen(url, timeout=60) as r:
+                        body = r.read()
+                        code = r.status if len(body) == len(small) else -1
+                except urllib.error.HTTPError as e:
+                    code = e.code
+                    e.read()
+                with lock:
+                    results.append(code)
+
+            threads = [threading.Thread(target=one) for _ in range(c)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return results.count(200), results.count(503)
+
+        curve = []
+        for c in (2, slots + depth, 2 * (slots + depth), 4 * (slots + depth)):
+            ok, shed = await asyncio.to_thread(storm, c)
+            assert ok + shed == c, f"unexpected statuses at c={c}"
+            curve.append({"concurrency": c, "ok": ok, "shed": shed})
+            log(f"phase 4: c={c}: {ok} ok, {shed} shed")
+        out["shed"] = {
+            "download_slots": slots, "queue_depth": depth,
+            "curve": curve,
+            "engages_only_beyond_depth":
+                all(p["shed"] == 0 for p in curve
+                    if p["concurrency"] <= slots + depth)
+                and any(p["shed"] > 0 for p in curve
+                        if p["concurrency"] > slots + depth),
+        }
+    finally:
+        await node.stop()
+    return out
+
+
+def main() -> int:
+    total = int(sys.argv[1]) if len(sys.argv) > 1 else 32 * 2**20
+    readers = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="bench_serve_") as tmp:
+        out = asyncio.run(run_phases(total, readers, Path(tmp)))
+    ok = (out["default_config_byte_identical"]
+          and out["cached_speedup"] >= 5.0
+          and out["singleflight"]["collapsed_to_unique"]
+          and out["shed"]["engages_only_beyond_depth"])
+    out["ok"] = bool(ok)
+    Path(__file__).parent.joinpath(ART).write_text(
+        json.dumps(out, indent=2) + "\n")
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

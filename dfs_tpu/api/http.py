@@ -30,7 +30,8 @@ _REASONS = {200: "OK", 201: "Created", 206: "Partial Content",
             400: "Bad Request", 404: "Not Found",
             405: "Method Not Allowed", 411: "Length Required",
             413: "Payload Too Large",
-            416: "Range Not Satisfiable", 500: "Internal Server Error"}
+            416: "Range Not Satisfiable", 500: "Internal Server Error",
+            503: "Service Unavailable"}
 MAX_BODY = 4 * 1024 * 1024 * 1024
 # plain (Content-Length) uploads above this stream through the
 # bounded-memory ingest instead of materializing the body in node RAM
@@ -83,6 +84,50 @@ def binary_head(status: int, length: int, filename: str) -> bytes:
             "Connection: close",
             f'Content-Disposition: attachment; filename="{safe}"']
     return ("\r\n".join(head) + "\r\n\r\n").encode()
+
+
+def _shed(node: "StorageNodeServer", e) -> bytes:
+    """503 + Retry-After: admission control refused the request — the
+    explicit alternative to unbounded queuing (every queued request
+    degrades every other one; a shed request costs one cheap retry)."""
+    import math as _math
+
+    node.counters.inc("http_shed")
+    return _resp(503, str(e).encode(), "text/plain; charset=utf-8",
+                 {"Retry-After": str(max(1, _math.ceil(e.retry_after_s)))})
+
+
+class _GatedBody:
+    """Streamed-body wrapper holding a download admission slot for the
+    LIFETIME of the body — gating that released at the first byte would
+    bound nothing. An explicit class, not a wrapper generator: closing a
+    never-started generator skips its ``finally`` entirely (the head
+    write can fail before the first iteration), which would leak the
+    slot forever."""
+
+    def __init__(self, gate, gen) -> None:
+        self._gate = gate
+        self._gen = gen
+        self._released = False
+
+    def __aiter__(self) -> "_GatedBody":
+        return self
+
+    async def __anext__(self):
+        try:
+            return await self._gen.__anext__()
+        except BaseException:       # incl. StopAsyncIteration
+            await self.aclose()
+            raise
+
+    async def aclose(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        try:
+            await self._gen.aclose()
+        finally:
+            self._gate.release()
 
 
 def _parse_range(value: str) -> tuple[int | None, int | None] | None:
@@ -182,6 +227,7 @@ async def _serve_one(node: "StorageNodeServer",
                      reader: asyncio.StreamReader) -> bytes:
     from dfs_tpu.node.runtime import (DownloadError, NotFoundError,
                                       RangeNotSatisfiable, UploadError)
+    from dfs_tpu.serve import ShedError
 
     request_line = (await reader.readline()).decode("latin-1").strip()
     if not request_line:
@@ -232,6 +278,7 @@ async def _serve_one(node: "StorageNodeServer",
         snap["underReplicated"] = len(node.under_replicated)
         snap["latency"] = node.latency.snapshot()
         snap["peersAlive"] = node.health.snapshot()
+        snap["serve"] = node.serve.stats()   # cache/flight/admission
         return as_json(200, snap)
 
     if method == "GET" and path == "/manifest":
@@ -278,37 +325,46 @@ async def _serve_one(node: "StorageNodeServer",
             return plain(411, "Length Required")
         if content_length > MAX_BODY:
             return plain(413, "Payload Too Large")
-        raw = await reader.readexactly(content_length)
+        gate = node.serve.admission.upload
         try:
-            jlen = int.from_bytes(raw[:4], "big")
-            meta = json.loads(raw[4:4 + jlen])
-            table = [(int(o), int(ln), str(dg))
-                     for o, ln, dg in meta["chunks"]]
-            lengths = {dg: ln for _, ln, dg in table}
-            provided: dict[str, bytes] = {}
-            off = 4 + jlen
-            for dg in meta["provided"]:
-                ln = lengths[dg]
-                provided[dg] = raw[off:off + ln]
-                off += ln
-            if off != len(raw):
-                raise ValueError("payload section length mismatch")
-            file_id, size = str(meta["fileId"]), int(meta["size"])
-        except (KeyError, ValueError, TypeError) as e:
-            return plain(400, f"Bad resume frame: {e}")
-        if _bad_id(file_id):
-            return plain(400, "Bad fileId")
+            await gate.acquire()   # shed BEFORE buffering the body
+        except ShedError as e:
+            return _shed(node, e)
         try:
-            manifest, stats = await node.upload_resume(
-                table, query.get("name", ""), file_id, size, provided)
-        except UploadError as e:
-            # 409 = resume no longer possible (client falls back to a
-            # full upload); 400 = bad frame/table; 500 = placement failed
-            return plain(e.status, str(e))
-        return as_json(201, {"fileId": manifest.file_id,
-                             "name": manifest.name,
-                             "size": manifest.size,
-                             "chunks": manifest.total_chunks, **stats})
+            raw = await reader.readexactly(content_length)
+            try:
+                jlen = int.from_bytes(raw[:4], "big")
+                meta = json.loads(raw[4:4 + jlen])
+                table = [(int(o), int(ln), str(dg))
+                         for o, ln, dg in meta["chunks"]]
+                lengths = {dg: ln for _, ln, dg in table}
+                provided: dict[str, bytes] = {}
+                off = 4 + jlen
+                for dg in meta["provided"]:
+                    ln = lengths[dg]
+                    provided[dg] = raw[off:off + ln]
+                    off += ln
+                if off != len(raw):
+                    raise ValueError("payload section length mismatch")
+                file_id, size = str(meta["fileId"]), int(meta["size"])
+            except (KeyError, ValueError, TypeError) as e:
+                return plain(400, f"Bad resume frame: {e}")
+            if _bad_id(file_id):
+                return plain(400, "Bad fileId")
+            try:
+                manifest, stats = await node.upload_resume(
+                    table, query.get("name", ""), file_id, size, provided)
+            except UploadError as e:
+                # 409 = resume no longer possible (client falls back to a
+                # full upload); 400 = bad frame/table; 500 = placement
+                # failed
+                return plain(e.status, str(e))
+            return as_json(201, {"fileId": manifest.file_id,
+                                 "name": manifest.name,
+                                 "size": manifest.size,
+                                 "chunks": manifest.total_chunks, **stats})
+        finally:
+            gate.release()
 
     if method == "POST" and path == "/upload":
         ec_k = 0
@@ -326,43 +382,16 @@ async def _serve_one(node: "StorageNodeServer",
                 return plain(411, "Length Required")  # reference parity
             if content_length > MAX_BODY:
                 return plain(413, "Payload Too Large")
-        if chunked or (content_length > STREAM_BODY_BYTES and not ec_k):
-            # streaming ingest: the body feeds the fragmenter's
-            # bounded-memory pipeline as it arrives — the whole payload
-            # never exists in node memory (the reference reads the
-            # entire body into one array, StorageNode.java:124). Since
-            # round 4 large PLAIN bodies take this path too, read off
-            # the socket in ~1 MiB pieces; EC uploads still materialize
-            # (parity stripes group chunks across the whole file).
-            async def _plain_body():
-                left = content_length
-                while left:
-                    b = await reader.read(min(1 << 20, left))
-                    if not b:
-                        raise asyncio.IncompleteReadError(b"", left)
-                    left -= len(b)
-                    yield b
-
-            body = _chunked_body(reader) if chunked else _plain_body()
-            try:
-                manifest, stats = await node.upload_stream(
-                    body, query.get("name", ""))
-            except UploadError as e:
-                return plain(getattr(e, "status", 500), str(e))
-            except ValueError as e:
-                return plain(400, f"Bad request body: {e}")
-        else:
-            data = await reader.readexactly(content_length)
-            try:
-                manifest, stats = await node.upload(
-                    data, query.get("name", ""), ec_k=ec_k)
-            except UploadError as e:
-                # "Replication failed" -> 500 (:176); ec validation -> 400
-                return plain(getattr(e, "status", 500), str(e))
-        return as_json(201, {"fileId": manifest.file_id,
-                             "name": manifest.name,
-                             "size": manifest.size,
-                             "chunks": manifest.total_chunks, **stats})
+        gate = node.serve.admission.upload
+        try:
+            await gate.acquire()   # shed BEFORE consuming the body
+        except ShedError as e:
+            return _shed(node, e)
+        try:
+            return await _handle_upload(node, reader, query, chunked,
+                                        content_length, ec_k)
+        finally:
+            gate.release()
 
     if method == "GET" and path == "/download":
         file_id = query.get("fileId")
@@ -386,6 +415,12 @@ async def _serve_one(node: "StorageNodeServer",
                 # §14.1.1: the Range header MUST be ignored (full 200
                 # body), not answered 416.
                 rng = None
+        gate = node.serve.admission.download
+        try:
+            await gate.acquire()
+        except ShedError as e:
+            return _shed(node, e)
+        streaming = None
         try:
             if rng is not None:
                 try:
@@ -406,11 +441,17 @@ async def _serve_one(node: "StorageNodeServer",
             # size). The first batch is fetched before the head is
             # written, so the common failures still answer 404/500.
             manifest, body_gen = await node.download_stream(file_id)
+            # the admission slot stays held until the body fully drains
+            # (or the client disconnects) — see _GatedBody
+            streaming = _GatedBody(gate, body_gen)
+            return binary_head(200, manifest.size, manifest.name), streaming
         except NotFoundError:
             return plain(404, "File not found")
         except DownloadError as e:
             return plain(500, str(e))
-        return binary_head(200, manifest.size, manifest.name), body_gen
+        finally:
+            if streaming is None:
+                gate.release()
 
     if method == "POST" and path == "/scrub":
         # verify every local chunk against its content address; corrupt
@@ -436,3 +477,50 @@ async def _serve_one(node: "StorageNodeServer",
                      "Deleted" if found else "File not found")
 
     return plain(404, "Not found")  # reference: unknown routes → 404, :107
+
+
+async def _handle_upload(node: "StorageNodeServer",
+                         reader: asyncio.StreamReader, query: dict,
+                         chunked: bool, content_length: int | None,
+                         ec_k: int) -> bytes:
+    """POST /upload body handling (factored out so the admission gate
+    wraps it in one try/finally)."""
+    from dfs_tpu.node.runtime import UploadError
+
+    if chunked or (content_length > STREAM_BODY_BYTES and not ec_k):
+        # streaming ingest: the body feeds the fragmenter's
+        # bounded-memory pipeline as it arrives — the whole payload
+        # never exists in node memory (the reference reads the
+        # entire body into one array, StorageNode.java:124). Since
+        # round 4 large PLAIN bodies take this path too, read off
+        # the socket in ~1 MiB pieces; EC uploads still materialize
+        # (parity stripes group chunks across the whole file).
+        async def _plain_body():
+            left = content_length
+            while left:
+                b = await reader.read(min(1 << 20, left))
+                if not b:
+                    raise asyncio.IncompleteReadError(b"", left)
+                left -= len(b)
+                yield b
+
+        body = _chunked_body(reader) if chunked else _plain_body()
+        try:
+            manifest, stats = await node.upload_stream(
+                body, query.get("name", ""))
+        except UploadError as e:
+            return plain(getattr(e, "status", 500), str(e))
+        except ValueError as e:
+            return plain(400, f"Bad request body: {e}")
+    else:
+        data = await reader.readexactly(content_length)
+        try:
+            manifest, stats = await node.upload(
+                data, query.get("name", ""), ec_k=ec_k)
+        except UploadError as e:
+            # "Replication failed" -> 500 (:176); ec validation -> 400
+            return plain(getattr(e, "status", 500), str(e))
+    return as_json(201, {"fileId": manifest.file_id,
+                         "name": manifest.name,
+                         "size": manifest.size,
+                         "chunks": manifest.total_chunks, **stats})

@@ -17,7 +17,7 @@ import sys
 from pathlib import Path
 
 from dfs_tpu.cli.client import NodeClient
-from dfs_tpu.config import CDCParams, ClusterConfig, NodeConfig
+from dfs_tpu.config import CDCParams, ClusterConfig, NodeConfig, ServeConfig
 
 
 def _client(args) -> NodeClient:
@@ -44,7 +44,13 @@ def cmd_serve(args) -> int:
         data_root=Path(args.data_root), fragmenter=args.fragmenter,
         sidecar_port=args.sidecar_port,
         cdc=CDCParams(min_size=args.min_chunk, avg_size=args.avg_chunk,
-                      max_size=args.max_chunk))
+                      max_size=args.max_chunk),
+        serve=ServeConfig(cache_bytes=args.cache_bytes,
+                          readahead_batches=args.readahead,
+                          download_slots=args.download_slots,
+                          upload_slots=args.upload_slots,
+                          internal_slots=args.internal_slots,
+                          queue_depth=args.queue_depth))
 
     async def run() -> None:
         node = StorageNodeServer(cfg)
@@ -269,6 +275,20 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--scrub-interval", type=float, default=3600.0,
                        help="seconds between local integrity sweeps "
                             "(re-hash every chunk; 0 disables)")
+    serve.add_argument("--cache-bytes", type=int, default=0,
+                       help="hot-chunk cache budget (serving tier); "
+                            "0 disables the cache + single-flight")
+    serve.add_argument("--readahead", type=int, default=0,
+                       help="streamed-download readahead depth (batches)")
+    serve.add_argument("--download-slots", type=int, default=0,
+                       help="concurrent download budget; 0 = unbounded")
+    serve.add_argument("--upload-slots", type=int, default=0,
+                       help="concurrent upload budget; 0 = unbounded")
+    serve.add_argument("--internal-slots", type=int, default=0,
+                       help="concurrent storage-plane bulk-op budget "
+                            "(store/get chunks); 0 = unbounded")
+    serve.add_argument("--queue-depth", type=int, default=64,
+                       help="waiters beyond the slots before 503 shedding")
     serve.add_argument("--sidecar-port", type=int, default=None,
                        help="delegate chunk+hash to a running sidecar "
                             "process (overrides --fragmenter)")

@@ -52,13 +52,24 @@ class InternalClient:
     _MAX_IDLE_PER_PEER = 4
 
     def __init__(self, connect_timeout_s: float = 2.0,
-                 request_timeout_s: float = 10.0, retries: int = 3) -> None:
+                 request_timeout_s: float = 10.0, retries: int = 3,
+                 coalesce_fetches: bool = False) -> None:
         self.connect_timeout_s = connect_timeout_s
         self.request_timeout_s = request_timeout_s
         self.retries = retries
         self._pool: dict[tuple[str, int],
                          list[tuple[asyncio.StreamReader,
                                     asyncio.StreamWriter]]] = {}
+        # Per-(peer, digest) single-flight for get_chunk: with the
+        # serving tier on, concurrent readers racing to the SAME replica
+        # for the SAME immutable chunk collapse into one wire transfer
+        # (a failure reaches the coalesced callers and clears — see
+        # serve.singleflight). Off by default: identical call behavior.
+        self._flight = None
+        if coalesce_fetches:
+            from dfs_tpu.serve.singleflight import SingleFlight
+
+            self._flight = SingleFlight()
 
     def _checkout(self, peer: PeerAddr):
         """Pop a live pooled connection, or None to signal a fresh dial."""
@@ -195,7 +206,27 @@ class InternalClient:
                                "fresh": fresh})
 
     async def get_chunk(self, peer: PeerAddr, digest: str) -> bytes:
-        _, body = await self.call(peer, {"op": "get_chunk", "digest": digest})
+        if self._flight is None:
+            _, body = await self.call(
+                peer, {"op": "get_chunk", "digest": digest})
+            return body
+        key = (peer.host, peer.internal_port, digest)
+        leader, fut = self._flight.claim(key)
+        if not leader:
+            # raises whatever RpcError the leader rejected with — never
+            # the leader's own CancelledError (converted below), so a
+            # coalesced caller whose request is alive falls back to the
+            # next replica like any failed fetch
+            return await self._flight.wait(fut)
+        try:
+            _, body = await self.call(
+                peer, {"op": "get_chunk", "digest": digest})
+        except BaseException as e:
+            exc = e if isinstance(e, RpcError) else RpcRemoteError(
+                f"coalesced fetch aborted: {type(e).__name__}: {e}")
+            self._flight.reject(key, exc)
+            raise
+        self._flight.resolve(key, body)
         return body
 
     async def get_chunks(self, peer: PeerAddr, digests: list[str],

@@ -139,6 +139,37 @@ class ClusterConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Read-path serving tier (dfs_tpu.serve) — hot-chunk cache,
+    single-flight coalescing, admission control, readahead.
+
+    EVERYTHING defaults off: a node built from ``ServeConfig()`` runs
+    byte-identical read/write code paths to the pre-serving-tier node
+    (tier-1 semantics unchanged); each knob enables one component.
+    """
+
+    cache_bytes: int = 0        # hot-chunk cache budget; 0 = no cache
+                                # (and no single-flight read path —
+                                # the two ride one switch, serve/__init__)
+    readahead_batches: int = 0  # streamed-download readahead depth K;
+                                # 0 = fetch batches strictly one at a time
+    download_slots: int = 0     # concurrent GET /download budget; 0 = no
+                                # gate (unbounded, the historical behavior)
+    upload_slots: int = 0       # concurrent POST /upload* budget
+    internal_slots: int = 0     # concurrent storage-plane ops budget
+    queue_depth: int = 64       # waiters beyond the slots before shedding
+    retry_after_s: float = 1.0  # advertised in 503 Retry-After
+
+    def __post_init__(self) -> None:
+        if self.cache_bytes < 0:
+            raise ValueError("cache_bytes must be >= 0")
+        if self.readahead_batches < 0:
+            raise ValueError("readahead_batches must be >= 0")
+        if self.queue_depth < 0:
+            raise ValueError("queue_depth must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
 class NodeConfig:
     """Per-node runtime configuration."""
 
@@ -166,6 +197,9 @@ class NodeConfig:
     # placement. quorum=1 would return 201 with a single copy in the world
     # when every peer is down — weaker than the reference (VERDICT r1 §6).
     write_quorum: int = 2
+    # read-path serving tier (cache / coalescing / shedding / readahead);
+    # default ServeConfig() disables every component
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
 
     @property
     def self_addr(self) -> PeerAddr:

@@ -268,6 +268,12 @@ class AnchoredTpuFragmenter(_AnchoredBase):
 
         self._staging_samples: collections.deque[tuple[int, float]] = \
             collections.deque(maxlen=64)
+        # warm the _touch jit once at construction (trace + a trivial
+        # 1-element compile): the readiness probe's one-time cost must
+        # never be billed to the first staging-bandwidth sample
+        import jax
+
+        jax.block_until_ready(_touch(np.zeros(1, np.uint32)))
 
     # -- pipelined region walk shared by chunk() and manifest_stream() ----
 
@@ -307,8 +313,16 @@ class AnchoredTpuFragmenter(_AnchoredBase):
         if measure:
             import time as _time
 
+            # dispatch _touch BEFORE starting the clock: its one-time
+            # jit trace/compile (first call per buffer shape) otherwise
+            # lands inside dt, inflating the first sample and
+            # misclassifying a fast link as slow — which held the first
+            # walk serial for 8 windows (ADVICE r5). __init__ also warms
+            # the jit machinery once so only the cheap per-shape
+            # retrace of `w[0]` remains here.
+            fut = _touch(words)
             t0 = _time.perf_counter()
-            jax.block_until_ready(_touch(words))
+            jax.block_until_ready(fut)
             dt = max(_time.perf_counter() - t0, 1e-9)
             self._staging_bw = staged.nbytes / dt
             self._since_measure = 0
@@ -375,13 +389,26 @@ class AnchoredTpuFragmenter(_AnchoredBase):
     def staging_observed_bw(self) -> float | None:
         """Aggregate bandwidth of the recent transfers the walk timed
         (up to the deque bound — the same-run link number its e2e rate
-        is honestly comparable to); None before any walk. Callers may
-        ``_staging_samples.clear()`` to scope the aggregate to one
-        run, as bench_e2e_stream does."""
+        is honestly comparable to); None before any walk. Scope the
+        aggregate to one run with :meth:`reset_staging_samples` before
+        it (as bench_e2e_stream does)."""
         if not self._staging_samples:
             return None
         return (sum(b for b, _ in self._staging_samples)
                 / sum(t for _, t in self._staging_samples))
+
+    def reset_staging_samples(self) -> int:
+        """Forget the recorded window-transfer timings (scoping the next
+        :meth:`staging_observed_bw` aggregate to the next run); returns
+        how many samples were dropped. The public face of the private
+        deque — benches must not reach into ``_staging_samples``."""
+        n = len(self._staging_samples)
+        self._staging_samples.clear()
+        return n
+
+    def staging_timed_windows(self) -> int:
+        """How many window transfers the current sample set timed."""
+        return len(self._staging_samples)
 
     def _walk(self, arr: np.ndarray, store=None) -> list[ChunkRef]:
         n = int(arr.shape[0])

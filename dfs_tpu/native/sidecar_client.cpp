@@ -371,6 +371,15 @@ int run_duplex(const std::string& host, const std::string& port,
           msg.find("\"done\"") != std::string::npos)
         got_done = true;
     }
+    // Trim the consumed prefix: `consumed` only ever advances, so the
+    // reply buffer would otherwise hold every streamed chunk report for
+    // the whole run — unbounded growth on multi-GiB conformance
+    // streams. Amortized: erase (an O(remaining) move) only once the
+    // dead prefix passes 1 MiB, never per message.
+    if (consumed > (1u << 20)) {
+      c.response.erase(0, consumed);
+      consumed = 0;
+    }
   };
 
   bool eof = false;

@@ -39,6 +39,7 @@ from dfs_tpu.meta.manifest import (ChunkRef, EcInfo, Manifest, StripeRef,
 from dfs_tpu.node.health import HealthMonitor
 from dfs_tpu.node.placement import (ec_shard_node, handoff_order,
                                     replica_set)
+from dfs_tpu.serve import BatchPrefetcher, ServingTier
 from dfs_tpu.store.cas import NodeStore
 from dfs_tpu.utils.hashing import (is_hex_digest, sha256_hex,
                                    sha256_many_hex)
@@ -139,6 +140,13 @@ def ec_shard_items(manifest: Manifest) -> list[tuple[str, int]]:
     return out
 
 
+# storage-plane ops the internal admission gate bounds: the ones that
+# move/hash chunk payloads. Everything else (health, has_chunks,
+# tombstones, list/get_manifest, announce, delete) is cheap metadata
+# whose timeliness other subsystems depend on — see _handle_internal.
+_HEAVY_OPS = frozenset({"store_chunks", "get_chunk", "get_chunks"})
+
+
 class StorageNodeServer:
     def __init__(self, cfg: NodeConfig) -> None:
         self.cfg = cfg
@@ -154,11 +162,17 @@ class StorageNodeServer:
                 cfg.fragmenter, cdc_params=cfg.cdc,
                 fixed_parts=cfg.fixed_parts)
         self.client = InternalClient(cfg.connect_timeout_s,
-                                     cfg.request_timeout_s, cfg.retries)
+                                     cfg.request_timeout_s, cfg.retries,
+                                     coalesce_fetches=cfg.serve.cache_bytes
+                                     > 0)
         self.health = HealthMonitor(cfg.cluster, cfg.node_id, self.client,
                                     probe_interval_s=cfg.health_probe_s)
         self.counters = Counters()
         self.latency = LatencyRecorder()
+        # read-path serving tier: hot-chunk cache + single-flight +
+        # admission gates + readahead. Default config = every component
+        # off, and the node runs the historical code paths exactly.
+        self.serve = ServingTier(cfg.serve)
         self.log = get_logger("node", cfg.node_id)
         self.under_replicated: set[str] = set()  # digests needing repair
         self._internal_server: asyncio.AbstractServer | None = None
@@ -210,7 +224,20 @@ class StorageNodeServer:
                 except WireError:
                     return
                 try:
-                    resp, rbody = await self._dispatch(header, body)
+                    gate = self.serve.admission.internal
+                    if gate.enabled and header.get("op") in _HEAVY_OPS:
+                        # bounded storage-plane concurrency for the BULK
+                        # ops only; a shed op surfaces to the peer as an
+                        # application error (RpcRemoteError — live peer,
+                        # not a death sign). Cheap O(1)/metadata ops —
+                        # health above all — bypass the gate: a health
+                        # probe queued behind multi-second transfers
+                        # past the prober's timeout would make a merely
+                        # BUSY node look dead and trigger repair churn.
+                        async with gate.slot():
+                            resp, rbody = await self._dispatch(header, body)
+                    else:
+                        resp, rbody = await self._dispatch(header, body)
                 except Exception as e:  # noqa: BLE001 - report to peer
                     resp, rbody = {"ok": False, "error": str(e)}, b""
                 await send_msg(writer, resp, rbody)
@@ -306,8 +333,7 @@ class StorageNodeServer:
                     "mtime": self.store.manifests.mtime(
                         header["fileId"])}, b""
         if op == "delete":
-            self.store.manifests.delete(header["fileId"])
-            self.store.gc()
+            self._forget_file(header["fileId"])
             return {"ok": True}, b""
         if op == "health":
             # counts must be O(1)/filename-only: every peer probes this
@@ -1353,6 +1379,93 @@ class StorageNodeServer:
 
     async def _fetch_verified(self, manifest: Manifest, chunks: list,
                               strict: bool = True) -> dict[str, bytes]:
+        """Serving-tier front of :meth:`_fetch_verified_direct`. With the
+        tier enabled (cfg.serve.cache_bytes > 0): hot digests come from
+        the in-memory SIEVE cache; cold digests are CLAIMED per digest
+        (single-flight) and every digest this caller wins is fetched in
+        one batched direct gather — leadership never degrades the read
+        into one-RPC-per-chunk — then verified bytes populate the cache
+        and resolve the waiters. A leader failure rejects its claims
+        (waiters of THIS flight see it; the next request re-leads — no
+        poisoning). Default config: exactly the direct path."""
+        serve = self.serve
+        if not serve.read_path_enabled:
+            return await self._fetch_verified_direct(manifest, chunks,
+                                                     strict)
+        length: dict[str, int] = {}
+        for c in chunks:
+            length.setdefault(c.digest, c.length)
+        out: dict[str, bytes] = {}
+        waits: dict[str, asyncio.Future] = {}
+        mine: list[str] = []
+        for d in length:
+            b = serve.cache.get(d)
+            if b is not None:
+                out[d] = b
+                continue
+            leader, fut = serve.flight.claim(d)
+            if leader:
+                mine.append(d)
+            else:
+                waits[d] = fut
+        if mine:
+            refs = [ChunkRef(index=0, offset=0, length=length[d],
+                             digest=d) for d in mine]
+            try:
+                got = await self._fetch_verified_direct(
+                    manifest, refs, strict=False)
+            except BaseException as e:
+                # convert a cancelled leader (client hung up mid-read)
+                # into a normal fetch failure for the waiters: their
+                # requests are alive and must not inherit cancellation
+                exc = e if isinstance(e, Exception) else DownloadError(
+                    "origin fetch cancelled")
+                for d in mine:
+                    serve.flight.reject(d, exc)
+                raise
+            for d in mine:
+                b = got.get(d)
+                if b is None:
+                    serve.flight.reject(d, DownloadError(
+                        f"Could not retrieve chunk {d[:12]}…"))
+                else:
+                    serve.cache.put(d, b)
+                    serve.flight.resolve(d, b)
+                    out[d] = b
+        failed_waits: list[str] = []
+        for d, fut in waits.items():
+            try:
+                out[d] = await serve.flight.wait(fut)
+            except DownloadError:
+                failed_waits.append(d)
+            except asyncio.CancelledError:
+                if not fut.done():
+                    raise                # WE were cancelled
+                failed_waits.append(d)   # the leader's flight died
+        if failed_waits:
+            # a rejected flight says nothing about THIS request: the
+            # leader may simply have been cancelled (its client hung
+            # up). Re-fetch directly — an innocent waiter must not 500
+            # on a healthy cluster; for genuinely lost chunks this one
+            # batched attempt is the same work the leader already paid.
+            refs = [ChunkRef(index=0, offset=0, length=length[d],
+                             digest=d) for d in failed_waits]
+            got = await self._fetch_verified_direct(
+                manifest, refs, strict=False)
+            for d in failed_waits:
+                b = got.get(d)
+                if b is not None:
+                    serve.cache.put(d, b)
+                    out[d] = b
+        missing = [d for d in length if d not in out]
+        if missing and strict:
+            raise DownloadError(
+                f"Could not retrieve chunk {missing[0][:12]}…")
+        return out
+
+    async def _fetch_verified_direct(self, manifest: Manifest,
+                                     chunks: list, strict: bool = True
+                                     ) -> dict[str, bytes]:
         """Gather a slice of a manifest's chunks with local copies
         digest-verified first (heal-on-read: rotten local chunks are
         evicted + queued for repair and re-fetched from replicas, the
@@ -1369,6 +1482,7 @@ class StorageNodeServer:
                 good[d] = b
             else:
                 self.store.chunks.delete(d)
+                self.serve.drop_cached([d])
                 self.under_replicated.add(d)
                 self.log.warning("evicted corrupt local chunk %s on read",
                                  d[:12])
@@ -1408,33 +1522,54 @@ class StorageNodeServer:
 
         async def gen():
             nonlocal first
+            # bounded readahead (serving tier): with K > 0 the next K
+            # batches fetch WHILE the current one drains to the socket,
+            # so storage plane and socket stop serializing; memory stays
+            # <= K+1 batches. K = 0 (default) keeps the strict
+            # one-batch-at-a-time schedule. Built HERE, not before the
+            # generator starts: batch 0 is already fetched above (eager
+            # failure surfacing before the response head), and a body
+            # that is closed before its first iteration must own no
+            # in-flight fetch tasks (an unstarted generator's finally
+            # never runs, so nothing else could cancel them).
+            pre: BatchPrefetcher | None = None
+            if self.serve.readahead_batches > 0 and len(batches) > 1:
+                pre = BatchPrefetcher(
+                    batches, lambda b: self._fetch_verified(manifest, b),
+                    self.serve.readahead_batches, start=1)
+                pre.prime()   # batches 1..K fetch while batch 0 drains
             hasher = hashlib.sha256()
             held: bytes | None = None
             total = 0
-            for i, batch in enumerate(batches):
-                if i:
-                    got = await self._fetch_verified(manifest, batch)
-                else:
-                    got, first = first, None   # don't pin batch 0 for the
-                    # whole download — peak stays ~one batch
-                payloads = [got[c.digest] for c in batch]
-                await asyncio.to_thread(
-                    lambda ps=payloads: [hasher.update(p) for p in ps])
-                for b in payloads:
-                    if held is not None:
-                        total += len(held)
-                        yield held
-                    held = b
-            if hasher.hexdigest() != file_id:
-                # mid-assembly corruption (e.g. a stale manifest): abort
-                # before the last byte — the client sees truncation, not
-                # a silently wrong file
-                raise DownloadError("File corrupted")
-            if held is not None:
-                total += len(held)
-                yield held
-            self.counters.inc("downloads")
-            self.counters.inc("download_bytes", total)
+            try:
+                for i, batch in enumerate(batches):
+                    if i:
+                        got = await (pre.get(i) if pre is not None else
+                                     self._fetch_verified(manifest, batch))
+                    else:
+                        got, first = first, None   # don't pin batch 0 for
+                        # the whole download — peak stays ~one batch
+                    payloads = [got[c.digest] for c in batch]
+                    await asyncio.to_thread(
+                        lambda ps=payloads: [hasher.update(p) for p in ps])
+                    for b in payloads:
+                        if held is not None:
+                            total += len(held)
+                            yield held
+                        held = b
+                if hasher.hexdigest() != file_id:
+                    # mid-assembly corruption (e.g. a stale manifest):
+                    # abort before the last byte — the client sees
+                    # truncation, not a silently wrong file
+                    raise DownloadError("File corrupted")
+                if held is not None:
+                    total += len(held)
+                    yield held
+                self.counters.inc("downloads")
+                self.counters.inc("download_bytes", total)
+            finally:
+                if pre is not None:    # abandoned stream: stop fetching
+                    await pre.close()
 
         return manifest, gen()
 
@@ -1442,7 +1577,13 @@ class StorageNodeServer:
         manifest = await self._resolve_manifest(file_id)
 
         with span("download.gather", self.latency):
-            by_digest = await self._gather_chunks(manifest)
+            if self.serve.read_path_enabled:
+                # cache + single-flight front; the whole-file hash gate
+                # below still guards assembly exactly as before
+                by_digest = await self._fetch_verified(
+                    manifest, list(manifest.chunks))
+            else:
+                by_digest = await self._gather_chunks(manifest)
         data = b"".join(by_digest[c.digest] for c in manifest.chunks)
         # Whole-file integrity gate, exactly the reference's
         # sha256(assembled) == fileId check (StorageNode.java:453-458) —
@@ -1466,9 +1607,32 @@ class StorageNodeServer:
     # delete + repair (new capabilities; absent in reference §2.5(5), §5.3)
     # ------------------------------------------------------------------ #
 
+    def _forget_file(self, file_id: str, ts: float | None = None,
+                     gc: bool = True) -> bool:
+        """Tombstone a manifest AND drop its bytes from serving memory —
+        the one delete sequence every path (user delete, the internal
+        delete op, tombstone anti-entropy) must share. The manifest is
+        loaded BEFORE tombstoning: the cache may hold chunks this node
+        only ever fetched remotely (never in the local store), which the
+        local GC's dead-list cannot name; correctness is unaffected
+        either way — content addressing means cached bytes are never
+        wrong, and the tombstone already blocks the file-level read.
+        ``ts`` propagates an ORIGIN deletion time (anti-entropy);
+        ``gc=False`` defers the orphan sweep to the caller (anti-entropy
+        runs ONE sweep after applying a whole round of tombstones).
+        With the cache off (default) the manifest load is skipped — the
+        pre-serving-tier delete paths never paid that read."""
+        m = self.store.manifests.load(file_id) \
+            if self.serve.cache is not None else None
+        found = self.store.manifests.delete(file_id, ts=ts)
+        if gc:
+            self.serve.drop_cached(self.store.gc())
+        if m is not None:
+            self.serve.drop_cached(m.all_digests())
+        return found
+
     async def delete(self, file_id: str) -> bool:
-        found = self.store.manifests.delete(file_id)   # tombstone persists
-        self.store.gc()
+        found = self._forget_file(file_id)   # tombstone persists
 
         async def forget(peer) -> None:
             try:
@@ -1535,12 +1699,13 @@ class StorageNodeServer:
                             pass
                     continue
                 # propagate with the ORIGIN timestamp (re-stamping would
-                # let the tombstone's ts creep forward as it gossips)
-                self.store.manifests.delete(fid, ts=ts)
+                # let the tombstone's ts creep forward as it gossips);
+                # one shared GC sweep runs after the whole round below
+                self._forget_file(fid, ts=ts, gc=False)
                 known.add(fid)
                 applied += 1
         if applied:
-            self.store.gc()
+            self.serve.drop_cached(self.store.gc())
             self.log.info("anti-entropy: applied %d tombstones", applied)
         return applied
 
@@ -1708,6 +1873,7 @@ class StorageNodeServer:
         # safe (manifest-last ordering makes their chunks look orphaned)
         swept = self.store.gc(min_age_s=3600.0)
         if swept:
+            self.serve.drop_cached(swept)
             self.log.info("gc: swept %d aged orphan chunks", len(swept))
         self.counters.inc("repairs")
         return repaired
@@ -1741,6 +1907,7 @@ class StorageNodeServer:
                 if not ok:
                     corrupt += 1
                     self.store.chunks.delete(d)
+                    self.serve.drop_cached([d])
                     self.under_replicated.add(d)
                     self.log.warning("scrub: corrupt chunk %s deleted",
                                      d[:12])

@@ -1,0 +1,85 @@
+"""Read-path serving tier (north-star: traffic, not storage).
+
+The storage plane below this package is content-addressed and immutable:
+a digest's bytes never change, deletes/GC only ever make entries vanish.
+That is the ideal substrate for the three classic serving-tier moves this
+package implements (the pattern that let memcache absorb billions of
+reads — Nishtala et al., NSDI '13):
+
+- :mod:`dfs_tpu.serve.cache` — byte-budgeted in-memory hot-chunk cache,
+  SIEVE eviction (scan-resistant FIFO with lazy promotion — Zhang et
+  al., NSDI '24). No invalidation problem exists: entries are only ever
+  dropped (delete/GC/scrub), never updated.
+- :mod:`dfs_tpu.serve.singleflight` — per-digest coalescing: N
+  concurrent readers of a chunk trigger exactly ONE local-store read or
+  peer RPC; failures propagate to current waiters without poisoning
+  later retries.
+- :mod:`dfs_tpu.serve.admission` — semaphore-bounded concurrency per
+  request class (download / upload / internal) with explicit load
+  shedding: beyond a configured queue depth requests get 503
+  Retry-After instead of unbounded queuing.
+- :mod:`dfs_tpu.serve.prefetch` — bounded readahead for streamed
+  downloads: the next K chunk batches fetch while the current one is
+  written to the socket.
+
+Everything is OFF by default (``ServeConfig()`` in config.py): a node
+with the default config has byte-identical read semantics to the
+pre-serving-tier code path — tier-1 tests enforce that.
+"""
+
+from __future__ import annotations
+
+from dfs_tpu.serve.admission import AdmissionControl, ShedError
+from dfs_tpu.serve.cache import ChunkCache
+from dfs_tpu.serve.prefetch import BatchPrefetcher
+from dfs_tpu.serve.singleflight import SingleFlight
+
+
+class ServingTier:
+    """One node's serving-tier state: the hot-chunk cache (None when the
+    budget is 0), the per-digest single-flight table, and the admission
+    gates. Constructed unconditionally by the node runtime — the
+    default-off config makes every component a no-op."""
+
+    def __init__(self, cfg) -> None:
+        self.cfg = cfg
+        self.cache = ChunkCache(cfg.cache_bytes) \
+            if cfg.cache_bytes > 0 else None
+        self.flight = SingleFlight()
+        self.admission = AdmissionControl(cfg)
+        self.readahead_batches = int(cfg.readahead_batches)
+
+    @property
+    def read_path_enabled(self) -> bool:
+        """The cache+single-flight read path activates together with the
+        cache budget: with no cache, coalescing would still collapse
+        concurrent duplicate fetches but the default-off contract is
+        'byte-identical code path', so both ride one switch."""
+        return self.cache is not None
+
+    def drop_cached(self, digests) -> int:
+        """Forget cached entries for deleted/GC'd/corrupt chunks. Purely
+        a memory-reclaim concern — content addressing means a cached
+        entry can never be *wrong*, only unreferenced."""
+        if self.cache is None:
+            return 0
+        n = 0
+        for d in digests:
+            if self.cache.drop(d):
+                n += 1
+        return n
+
+    def stats(self) -> dict:
+        """Aggregate serving-tier stats for the /metrics endpoint."""
+        out: dict = {
+            "flight": self.flight.stats(),
+            "admission": self.admission.stats(),
+            "readaheadBatches": self.readahead_batches,
+        }
+        out["cache"] = self.cache.stats() if self.cache is not None \
+            else {"enabled": False}
+        return out
+
+
+__all__ = ["AdmissionControl", "BatchPrefetcher", "ChunkCache",
+           "ServingTier", "ShedError", "SingleFlight"]

@@ -1,0 +1,127 @@
+"""Admission control: bounded concurrency per request class + load shed.
+
+Without backpressure an overloaded asyncio node degrades every request at
+once — each new reader adds event-loop and memory pressure until all of
+them time out together (congestion collapse). The fix is the standard
+one: a semaphore-bounded concurrency gate per request class (download /
+upload / internal) with a BOUNDED wait queue, and explicit shedding
+beyond it — a request that cannot be queued gets an immediate
+``503 Retry-After`` (:class:`ShedError` at this layer), which costs the
+client one cheap retry instead of costing every in-flight request its
+latency budget.
+
+``slots <= 0`` disables a gate entirely (the default config): acquire
+returns synchronously, no counters move, tier-1 semantics unchanged.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+
+import asyncio
+
+
+class ShedError(RuntimeError):
+    """Request refused by admission control — maps to HTTP 503 with a
+    Retry-After header at the API layer."""
+
+    def __init__(self, cls: str, retry_after_s: float) -> None:
+        super().__init__(f"{cls} capacity exhausted, retry after "
+                         f"{retry_after_s:g}s")
+        self.request_class = cls
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionGate:
+    """One request class's gate: up to ``slots`` concurrent holders, up
+    to ``queue_depth`` waiters, shed beyond that."""
+
+    def __init__(self, name: str, slots: int, queue_depth: int,
+                 retry_after_s: float = 1.0) -> None:
+        self.name = name
+        self.slots = int(slots)
+        self.queue_depth = max(0, int(queue_depth))
+        self.retry_after_s = float(retry_after_s)
+        self._active = 0
+        self._queue: collections.deque[asyncio.Future] = collections.deque()
+        self.admitted = 0
+        self.queued = 0
+        self.shed = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.slots > 0
+
+    async def acquire(self) -> None:
+        if not self.enabled:
+            return
+        if self._active < self.slots:
+            self._active += 1
+            self.admitted += 1
+            return
+        # a cancelled waiter stays in the deque until release() skips it;
+        # counting only live futures keeps ghosts from eating the depth
+        waiting = sum(1 for f in self._queue if not f.done())
+        if waiting >= self.queue_depth:
+            self.shed += 1
+            raise ShedError(self.name, self.retry_after_s)
+        fut = asyncio.get_running_loop().create_future()
+        self._queue.append(fut)
+        self.queued += 1
+        try:
+            await fut
+        except asyncio.CancelledError:
+            if fut.done() and not fut.cancelled():
+                # the grant raced our cancellation: the slot was already
+                # transferred to us — hand it to the next waiter
+                self._release_slot()
+            raise
+        self.admitted += 1
+
+    def release(self) -> None:
+        if not self.enabled:
+            return
+        self._release_slot()
+
+    def _release_slot(self) -> None:
+        while self._queue:
+            fut = self._queue.popleft()
+            if not fut.done():
+                fut.set_result(None)   # slot transfers: _active unchanged
+                return
+        self._active -= 1
+
+    @contextlib.asynccontextmanager
+    async def slot(self):
+        await self.acquire()
+        try:
+            yield
+        finally:
+            self.release()
+
+    def stats(self) -> dict:
+        return {"slots": self.slots, "queueDepth": self.queue_depth,
+                "active": self._active,
+                "waiting": sum(1 for f in self._queue if not f.done()),
+                "admitted": self.admitted, "queuedTotal": self.queued,
+                "shed": self.shed}
+
+
+class AdmissionControl:
+    """The node's three gates, built from a ServeConfig."""
+
+    def __init__(self, cfg) -> None:
+        self.download = AdmissionGate(
+            "download", cfg.download_slots, cfg.queue_depth,
+            cfg.retry_after_s)
+        self.upload = AdmissionGate(
+            "upload", cfg.upload_slots, cfg.queue_depth, cfg.retry_after_s)
+        self.internal = AdmissionGate(
+            "internal", cfg.internal_slots, cfg.queue_depth,
+            cfg.retry_after_s)
+
+    def stats(self) -> dict:
+        return {g.name: g.stats()
+                for g in (self.download, self.upload, self.internal)
+                if g.enabled} or {"enabled": False}

@@ -135,17 +135,19 @@ class ChunkStore:
             except FileExistsError:
                 return False
             except OSError as e:
-                # filesystem without hard links (or cross-device layout):
-                # fall back to atomic rename. Loses the exactly-one-True
-                # race guarantee (both racers see True, count drifts by
-                # one until restart) but never loses data — rename is
-                # still atomic and content-addressed names make the
-                # overwrite idempotent. Only the no-hardlink errnos take
-                # the fallback; anything else (vanished tmp, EIO) stays
-                # loud with its real cause.
+                # filesystem without hard links: fall back to atomic
+                # rename. Loses the exactly-one-True race guarantee
+                # (both racers see True, count drifts by one until
+                # restart) but never loses data — rename is still atomic
+                # and content-addressed names make the overwrite
+                # idempotent. Only the no-hardlink errnos take the
+                # fallback; anything else (vanished tmp, EIO, and EXDEV
+                # — tmp is created in the target's OWN directory, so a
+                # cross-device link error means something anomalous that
+                # os.replace would also fail on, just with a less
+                # accurate traceback) stays loud with its real cause.
                 if e.errno not in (errno.EPERM, errno.EOPNOTSUPP,
-                                   errno.ENOTSUP, errno.EXDEV,
-                                   errno.EMLINK):
+                                   errno.ENOTSUP, errno.EMLINK):
                     raise
                 os.replace(tmp, p)
         finally:

@@ -1,0 +1,496 @@
+"""Serving-tier tests (dfs_tpu/serve): SIEVE cache semantics under a
+byte budget, single-flight coalescing + failure non-poisoning, admission
+gate shedding (unit and over real HTTP), streamed downloads with
+readahead byte-identical to the plain path, and delete/GC dropping
+cached entries.
+
+Cluster scaffolding reuses test_node_cluster's helpers — nodes here run
+with the serving tier ENABLED (the rest of the suite runs the default
+config, which is the tier-off regression guard)."""
+
+import asyncio
+import socket
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dfs_tpu.config import CDCParams, ClusterConfig, NodeConfig, PeerAddr, \
+    ServeConfig
+from dfs_tpu.node.runtime import DownloadError, StorageNodeServer
+from dfs_tpu.serve.admission import AdmissionGate, ShedError
+from dfs_tpu.serve.cache import ChunkCache
+from dfs_tpu.serve.singleflight import SingleFlight
+
+CDC = CDCParams(min_size=64, avg_size=256, max_size=1024)
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def make_cluster_cfg(n: int, rf: int = 2) -> ClusterConfig:
+    ports = _free_ports(2 * n)
+    peers = tuple(
+        PeerAddr(node_id=i + 1, host="127.0.0.1",
+                 port=ports[2 * i], internal_port=ports[2 * i + 1])
+        for i in range(n))
+    return ClusterConfig(peers=peers, replication_factor=rf)
+
+
+async def start_nodes(cluster, root, serve: ServeConfig, ids=None,
+                      **cfg_kw):
+    nodes = {}
+    cfg_kw.setdefault("cdc", CDC)
+    for p in cluster.peers:
+        if ids is not None and p.node_id not in ids:
+            continue
+        cfg = NodeConfig(node_id=p.node_id, cluster=cluster,
+                         data_root=root, fragmenter="cdc", serve=serve,
+                         **cfg_kw)
+        node = StorageNodeServer(cfg)
+        await node.start()
+        nodes[p.node_id] = node
+    return nodes
+
+
+async def stop_nodes(nodes) -> None:
+    for n in nodes.values():
+        await n.stop()
+
+
+# --------------------------------------------------------------------- #
+# cache.py — SIEVE semantics
+# --------------------------------------------------------------------- #
+
+def test_cache_hit_miss_and_budget_eviction():
+    c = ChunkCache(budget_bytes=300)
+    assert c.get("a" * 64) is None           # miss
+    assert c.put("a" * 64, b"x" * 100)
+    assert c.get("a" * 64) == b"x" * 100     # hit
+    assert c.put("b" * 64, b"y" * 100)
+    assert c.put("c" * 64, b"z" * 100)       # exactly at budget
+    assert c.bytes_used == 300 and len(c) == 3
+    assert c.put("d" * 64, b"w" * 100)       # forces one eviction
+    assert c.bytes_used == 300 and len(c) == 3
+    s = c.stats()
+    assert s["evictions"] == 1 and s["hits"] == 1 and s["misses"] == 1
+    # an entry bigger than the whole budget is refused outright
+    assert not c.put("e" * 64, b"!" * 301)
+    assert len(c) == 3
+
+
+def test_cache_sieve_keeps_visited_entry_over_cold_scan():
+    """The SIEVE property: a HIT entry survives the eviction pass that
+    removes never-touched (scan) entries inserted after it."""
+    c = ChunkCache(budget_bytes=300)
+    c.put("hot0" + "a" * 60, b"h" * 100)
+    c.put("cold" + "b" * 60, b"c" * 100)
+    assert c.get("hot0" + "a" * 60) is not None    # mark visited
+    c.put("new0" + "c" * 60, b"n" * 100)           # fills budget
+    c.put("new1" + "d" * 60, b"m" * 100)           # must evict ONE
+    # the cold never-visited entry goes; the visited one survives
+    assert c.get("hot0" + "a" * 60) is not None
+    assert "cold" + "b" * 60 not in c._map
+
+
+def test_cache_drop_and_clear():
+    c = ChunkCache(budget_bytes=1000)
+    c.put("a" * 64, b"1" * 10)
+    c.put("b" * 64, b"2" * 10)
+    assert c.drop("a" * 64) and not c.drop("a" * 64)
+    assert c.bytes_used == 10
+    c.clear()
+    assert len(c) == 0 and c.bytes_used == 0
+    # eviction state (the hand) survives drops without corruption
+    for i in range(9):
+        c.put(f"{i}" * 64, bytes([i]) * 100)
+    assert c.bytes_used <= 1000
+
+
+# --------------------------------------------------------------------- #
+# singleflight.py — coalescing + failure propagation
+# --------------------------------------------------------------------- #
+
+def test_singleflight_collapses_concurrent_fetches():
+    calls = 0
+
+    async def run():
+        sf = SingleFlight()
+
+        async def fetch():
+            nonlocal calls
+            calls += 1
+            await asyncio.sleep(0.02)
+            return b"payload"
+
+        outs = await asyncio.gather(
+            *(sf.do("k", fetch) for _ in range(16)))
+        assert all(o == b"payload" for o in outs)
+        assert sf.stats()["coalesced"] == 15
+
+    asyncio.run(run())
+    assert calls == 1
+
+
+def test_singleflight_failure_reaches_waiters_without_poisoning():
+    calls = 0
+
+    async def run():
+        sf = SingleFlight()
+
+        async def failing():
+            nonlocal calls
+            calls += 1
+            await asyncio.sleep(0.02)
+            raise DownloadError("origin down")
+
+        outs = await asyncio.gather(
+            *(sf.do("k", failing) for _ in range(8)),
+            return_exceptions=True)
+        # the ONE origin failure propagated to every concurrent caller
+        assert calls == 1
+        assert all(isinstance(o, DownloadError) for o in outs)
+
+        # ...and the key is NOT poisoned: a later attempt runs fresh
+        async def ok():
+            nonlocal calls
+            calls += 1
+            return b"fine"
+
+        assert await sf.do("k", ok) == b"fine"
+        assert sf.stats()["inflight"] == 0
+
+    asyncio.run(run())
+    assert calls == 2
+
+
+# --------------------------------------------------------------------- #
+# admission.py — gate semantics
+# --------------------------------------------------------------------- #
+
+def test_admission_gate_sheds_beyond_queue_depth():
+    async def run():
+        g = AdmissionGate("download", slots=2, queue_depth=1,
+                          retry_after_s=2.0)
+        await g.acquire()
+        await g.acquire()                     # both slots held
+        waiter = asyncio.ensure_future(g.acquire())
+        await asyncio.sleep(0)                # waiter is queued (depth 1)
+        with pytest.raises(ShedError) as ei:
+            await g.acquire()                 # queue full -> shed
+        assert ei.value.retry_after_s == 2.0
+        assert g.stats()["shed"] == 1
+        g.release()                           # slot transfers to waiter
+        await waiter
+        assert g.stats()["active"] == 2
+        g.release()
+        g.release()
+        assert g.stats()["active"] == 0
+
+    asyncio.run(run())
+
+
+def test_admission_gate_disabled_is_noop():
+    async def run():
+        g = AdmissionGate("upload", slots=0, queue_depth=0)
+        for _ in range(100):
+            await g.acquire()                 # never sheds, never counts
+        assert g.stats()["active"] == 0
+
+    asyncio.run(run())
+
+
+def test_admission_cancelled_waiter_does_not_leak_slot():
+    async def run():
+        g = AdmissionGate("x", slots=1, queue_depth=4)
+        await g.acquire()
+        w1 = asyncio.ensure_future(g.acquire())
+        w2 = asyncio.ensure_future(g.acquire())
+        await asyncio.sleep(0)
+        w1.cancel()
+        await asyncio.gather(w1, return_exceptions=True)
+        g.release()                           # must skip the dead waiter
+        await asyncio.wait_for(w2, 1.0)
+        g.release()
+        assert g.stats()["active"] == 0
+
+    asyncio.run(run())
+
+
+# --------------------------------------------------------------------- #
+# integration: serving tier on a real cluster
+# --------------------------------------------------------------------- #
+
+SERVE_ON = ServeConfig(cache_bytes=32 * 1024 * 1024, readahead_batches=2)
+
+
+def test_concurrent_hot_reads_coalesce_to_one_origin_read(tmp_path, rng):
+    """N concurrent readers of the same cold file trigger exactly ONE
+    local-store read per unique chunk (single-flight), and a repeat read
+    is served fully from cache (zero store reads)."""
+    data = rng.integers(0, 256, size=200_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(1, rf=1)
+        nodes = await start_nodes(cluster, tmp_path, SERVE_ON)
+        try:
+            m, _ = await nodes[1].upload(data, "hot.bin")
+            store = nodes[1].store.chunks
+            reads = 0
+            orig_get = store.get
+
+            def counting_get(d):
+                nonlocal reads
+                reads += 1
+                return orig_get(d)
+
+            store.get = counting_get
+
+            async def read() -> bytes:
+                _, gen = await nodes[1].download_stream(m.file_id)
+                return b"".join([p async for p in gen])
+
+            outs = await asyncio.gather(*(read() for _ in range(32)))
+            assert all(o == data for o in outs)
+            unique = len({c.digest for c in m.chunks})
+            assert reads == unique, \
+                f"{reads} origin reads for {unique} unique chunks"
+            # repeat read: all cache hits, zero store reads
+            reads = 0
+            assert await read() == data
+            assert reads == 0
+            assert nodes[1].serve.cache.stats()["hits"] > 0
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_streamed_download_with_readahead_byte_identical(tmp_path, rng):
+    """Readahead (K=2) over many small fetch batches must produce the
+    exact bytes of the non-prefetching path, cross-node."""
+    data = rng.integers(0, 256, size=300_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path, SERVE_ON)
+        try:
+            m, _ = await nodes[1].upload(data, "ra.bin")
+            nodes[2]._FETCH_BATCH_BYTES = 16 * 1024  # many batches
+            _, gen = await nodes[2].download_stream(m.file_id)
+            got = b"".join([p async for p in gen])
+            assert got == data
+            assert nodes[2].counters.snapshot()["downloads"] == 1
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_failed_origin_fetch_does_not_poison_retry(tmp_path, rng):
+    """Every replica of one chunk is corrupted -> concurrent reads fail;
+    after the bytes are restored, the SAME node serves the file — the
+    single-flight failure must not stick to the digest."""
+    data = rng.integers(0, 256, size=60_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(1, rf=1)
+        nodes = await start_nodes(cluster, tmp_path, SERVE_ON)
+        try:
+            m, _ = await nodes[1].upload(data, "flaky.bin")
+            victim = m.chunks[0].digest
+            p = nodes[1].store.chunks._path(victim)
+            raw = p.read_bytes()
+            bad = bytes([raw[0] ^ 0xFF]) + raw[1:]
+            p.write_bytes(bad)
+
+            async def read() -> bytes:
+                _, gen = await nodes[1].download_stream(m.file_id)
+                return b"".join([p async for p in gen])
+
+            outs = await asyncio.gather(*(read() for _ in range(4)),
+                                        return_exceptions=True)
+            assert all(isinstance(o, Exception) for o in outs)
+            # restore the chunk; the next read must succeed
+            nodes[1].store.chunks.put(victim, raw, verify=False)
+            assert await read() == data
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_waiter_survives_cancelled_leader(tmp_path, rng):
+    """A reader whose single-flight leader gets CANCELLED (that client
+    hung up) must re-fetch and succeed — an innocent concurrent reader
+    never fails on a healthy cluster because of someone else's
+    disconnect."""
+    data = rng.integers(0, 256, size=60_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(1, rf=1)
+        nodes = await start_nodes(cluster, tmp_path, SERVE_ON)
+        try:
+            m, _ = await nodes[1].upload(data, "x.bin")
+            orig = nodes[1]._fetch_verified_direct
+
+            async def slow(*a, **kw):
+                await asyncio.sleep(0.1)   # window to cancel the leader
+                return await orig(*a, **kw)
+
+            nodes[1]._fetch_verified_direct = slow
+
+            async def read() -> bytes:
+                _, gen = await nodes[1].download_stream(m.file_id)
+                return b"".join([p async for p in gen])
+
+            leader = asyncio.ensure_future(read())
+            await asyncio.sleep(0.02)      # leader holds the claims
+            waiter = asyncio.ensure_future(read())
+            await asyncio.sleep(0.02)      # waiter joined the flights
+            leader.cancel()
+            await asyncio.gather(leader, return_exceptions=True)
+            assert await waiter == data
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_delete_drops_cached_entries(tmp_path, rng):
+    """Delete must empty the serving cache on every node — including
+    entries a node only ever held as REMOTE fetches (absent from its
+    local store, so the local GC dead-list alone cannot name them)."""
+    data = rng.integers(0, 256, size=80_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(2, rf=1)   # rf=1: most chunks live on
+        nodes = await start_nodes(cluster, tmp_path, SERVE_ON)  # ONE node
+        try:
+            m, _ = await nodes[1].upload(data, "temp.bin")
+            for n in nodes.values():
+                _, gen = await n.download_stream(m.file_id)
+                assert b"".join([p async for p in gen]) == data
+                assert len(n.serve.cache) > 0
+            # node 2's cache now holds chunks fetched from node 1's store
+            assert await nodes[1].delete(m.file_id)
+            for n in nodes.values():
+                cache = n.serve.cache
+                assert len(cache) == 0 and cache.bytes_used == 0, \
+                    f"node {n.cfg.node_id} cache not emptied"
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_http_download_sheds_503_when_gate_full(tmp_path, rng):
+    """With the download gate saturated (slots held, queue_depth=0), a
+    real HTTP GET /download answers 503 + Retry-After; after release it
+    serves 200 with correct bytes. /metrics reports the shed."""
+    data = rng.integers(0, 256, size=30_000, dtype=np.uint8).tobytes()
+    serve = ServeConfig(download_slots=1, queue_depth=0,
+                        retry_after_s=3.0)
+
+    async def run():
+        cluster = make_cluster_cfg(1, rf=1)
+        nodes = await start_nodes(cluster, tmp_path, serve)
+        port = cluster.peer(1).port
+        try:
+            m, _ = await nodes[1].upload(data, "shed.bin")
+            url = f"http://127.0.0.1:{port}/download?fileId={m.file_id}"
+            # hold the single slot directly (deterministic saturation)
+            await nodes[1].serve.admission.download.acquire()
+
+            def get():
+                try:
+                    with urllib.request.urlopen(url, timeout=10) as r:
+                        return r.status, dict(r.headers), r.read()
+                except urllib.error.HTTPError as e:
+                    return e.code, dict(e.headers), e.read()
+
+            status, headers, _ = await asyncio.to_thread(get)
+            assert status == 503
+            assert headers.get("Retry-After") == "3"
+            nodes[1].serve.admission.download.release()
+            status, _, body = await asyncio.to_thread(get)
+            assert status == 200 and body == data
+            # the shed is visible in /metrics
+            murl = f"http://127.0.0.1:{port}/metrics"
+            import json as _json
+
+            def metrics():
+                with urllib.request.urlopen(murl, timeout=10) as r:
+                    return _json.loads(r.read())
+
+            snap = await asyncio.to_thread(metrics)
+            assert snap["http_shed"] == 1
+            assert snap["serve"]["admission"]["download"]["shed"] == 1
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_http_upload_sheds_503_when_gate_full(tmp_path, rng):
+    data = rng.integers(0, 256, size=10_000, dtype=np.uint8).tobytes()
+    serve = ServeConfig(upload_slots=1, queue_depth=0, retry_after_s=1.0)
+
+    async def run():
+        cluster = make_cluster_cfg(1, rf=1)
+        nodes = await start_nodes(cluster, tmp_path, serve)
+        port = cluster.peer(1).port
+        try:
+            await nodes[1].serve.admission.upload.acquire()
+            url = f"http://127.0.0.1:{port}/upload?name=x.bin"
+
+            def post():
+                req = urllib.request.Request(url, data=data, method="POST")
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        return r.status
+                except urllib.error.HTTPError as e:
+                    return e.code
+
+            assert await asyncio.to_thread(post) == 503
+            nodes[1].serve.admission.upload.release()
+            assert await asyncio.to_thread(post) == 201
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_default_config_serving_tier_fully_off(tmp_path, rng):
+    """The regression contract: a default-config node has no cache, no
+    gates, and identical read results — and its /metrics shows the tier
+    disabled."""
+    data = rng.integers(0, 256, size=50_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(1, rf=1)
+        nodes = await start_nodes(cluster, tmp_path, ServeConfig())
+        try:
+            n = nodes[1]
+            assert n.serve.cache is None
+            assert not n.serve.read_path_enabled
+            assert not n.serve.admission.download.enabled
+            m, _ = await n.upload(data, "plain.bin")
+            _, got = await n.download(m.file_id)
+            assert got == data
+            assert n.serve.flight.stats()["leads"] == 0  # never engaged
+            assert n.serve.stats()["cache"] == {"enabled": False}
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())

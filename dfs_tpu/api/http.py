@@ -279,6 +279,8 @@ async def _serve_one(node: "StorageNodeServer",
         snap["latency"] = node.latency.snapshot()
         snap["peersAlive"] = node.health.snapshot()
         snap["serve"] = node.serve.stats()   # cache/flight/admission
+        snap["ingest"] = node.ingest_stats()  # write-path pipeline:
+        # window/credit bounds, stall attribution, CAS-tier queue/busy
         return as_json(200, snap)
 
     if method == "GET" and path == "/manifest":

@@ -17,7 +17,8 @@ import sys
 from pathlib import Path
 
 from dfs_tpu.cli.client import NodeClient
-from dfs_tpu.config import CDCParams, ClusterConfig, NodeConfig, ServeConfig
+from dfs_tpu.config import (CDCParams, ClusterConfig, IngestConfig,
+                            NodeConfig, ServeConfig)
 
 
 def _client(args) -> NodeClient:
@@ -50,7 +51,12 @@ def cmd_serve(args) -> int:
                           download_slots=args.download_slots,
                           upload_slots=args.upload_slots,
                           internal_slots=args.internal_slots,
-                          queue_depth=args.queue_depth))
+                          queue_depth=args.queue_depth),
+        ingest=IngestConfig(window=args.ingest_window,
+                            flush_bytes=args.ingest_flush_bytes,
+                            credit_bytes=args.ingest_credit_bytes,
+                            slice_inflight=args.replicate_inflight,
+                            cas_io_threads=args.cas_io_threads))
 
     async def run() -> None:
         node = StorageNodeServer(cfg)
@@ -292,6 +298,22 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--sidecar-port", type=int, default=None,
                        help="delegate chunk+hash to a running sidecar "
                             "process (overrides --fragmenter)")
+    serve.add_argument("--ingest-window", type=int, default=2,
+                       help="streaming-ingest placement batches in "
+                            "flight (1 = serial write path)")
+    serve.add_argument("--ingest-flush-bytes", type=int,
+                       default=32 * 1024 * 1024,
+                       help="streaming-ingest placement batch size")
+    serve.add_argument("--ingest-credit-bytes", type=int,
+                       default=64 * 1024 * 1024,
+                       help="byte budget of produced-but-unplaced chunks "
+                            "(fragmenter backpressure)")
+    serve.add_argument("--replicate-inflight", type=int, default=2,
+                       help="replication slices in flight per peer "
+                            "(1 = serial slices)")
+    serve.add_argument("--cas-io-threads", type=int, default=4,
+                       help="async CAS tier worker threads (local chunk "
+                            "file I/O off the event loop)")
     serve.set_defaults(fn=cmd_serve)
 
     sc = sub.add_parser("sidecar", help="run the chunk+hash sidecar service")

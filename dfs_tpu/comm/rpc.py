@@ -26,6 +26,7 @@ from typing import Any
 from dfs_tpu.comm.wire import (WireError, pack_chunks, read_msg, send_msg,
                                unpack_chunks)
 from dfs_tpu.config import PeerAddr
+from dfs_tpu.utils.aio import gather_abort_siblings
 
 
 class RpcError(RuntimeError):
@@ -195,6 +196,50 @@ class InternalClient:
             peer, {"op": "store_chunks", "fileId": file_id, "chunks": table},
             body, timeout_s=self._bulk_timeout(len(body)))
         return list(resp.get("digests", []))
+
+    async def store_chunks_windowed(
+            self, peer: PeerAddr, file_id: str,
+            slices: list[list[tuple[str, bytes]]], window: int = 2,
+            on_slice=None) -> int:
+        """Send payload slices with up to ``window`` concurrently in
+        flight to ONE peer, over pooled connections (each in-flight
+        slice rides its own connection — the pool dials as needed and
+        keeps up to ``_MAX_IDLE_PER_PEER`` warm). Strictly-serial slice
+        sending left the wire idle while the receiver ran its hash-echo
+        pass over the previous slice; windowing overlaps transfer of
+        slice N+1 with the peer verifying slice N.
+
+        ``on_slice(part, echoed)`` runs as each slice completes
+        (completion order) — the caller verifies the hash echo and does
+        per-slice accounting there; an exception it raises cancels the
+        remaining in-flight slices and propagates (so a mismatch fails
+        the peer exactly like the serial path did). Returns the peak
+        number of slices that were actually in flight at once."""
+        window = max(1, window)
+        if window == 1 or len(slices) <= 1:
+            for part in slices:
+                echoed = await self.store_chunks(peer, file_id, part)
+                if on_slice is not None:
+                    on_slice(part, echoed)
+            return 1 if slices else 0
+        sem = asyncio.Semaphore(window)
+        inflight = 0
+        peak = 0
+
+        async def one(part: list[tuple[str, bytes]]) -> None:
+            nonlocal inflight, peak
+            async with sem:
+                inflight += 1
+                peak = max(peak, inflight)
+                try:
+                    echoed = await self.store_chunks(peer, file_id, part)
+                finally:
+                    inflight -= 1
+                if on_slice is not None:
+                    on_slice(part, echoed)
+
+        await gather_abort_siblings(*(one(p) for p in slices))
+        return peak
 
     async def announce(self, peer: PeerAddr, manifest_json: str,
                        fresh: bool = False) -> None:

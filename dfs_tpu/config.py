@@ -170,6 +170,45 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class IngestConfig:
+    """Pipelined write path (docs/ingest.md) — the knobs bounding how much
+    of the three-stage ingest pipeline (fragmentation, local CAS writes,
+    peer replication) may be in flight at once.
+
+    ``window=1`` with ``slice_inflight=1`` reproduces the historical
+    strictly-serial schedule (each ~``flush_bytes`` batch fully placed
+    before the next one starts); the defaults overlap chunking batch N+1
+    with replicating batch N, which is where streaming-ingest wall time
+    went once replication latency dominated (INGEST_r07.json: windowed
+    ingest 2.66x serial under injected peer latency).
+    """
+
+    window: int = 2             # _place_batch calls in flight during
+                                # streaming ingest; 1 = serial placement
+    flush_bytes: int = 32 * 1024 * 1024   # batch size streaming ingest
+                                # accumulates before placing
+    credit_bytes: int = 64 * 1024 * 1024  # byte budget of produced-but-
+                                # unconsumed chunks (fragmenter-thread
+                                # backpressure); bounds ingest memory by
+                                # BYTES, not chunk count
+    slice_inflight: int = 2     # replication slices in flight PER PEER
+                                # (pooled connections); 1 = serial slices
+    cas_io_threads: int = 4     # worker threads of the async CAS tier
+                                # (store/aio.py) — local chunk file I/O
+                                # off the event loop
+
+    def __post_init__(self) -> None:
+        if self.window < 1:
+            raise ValueError("window must be >= 1")
+        if self.flush_bytes <= 0 or self.credit_bytes <= 0:
+            raise ValueError("flush_bytes/credit_bytes must be > 0")
+        if self.slice_inflight < 1:
+            raise ValueError("slice_inflight must be >= 1")
+        if self.cas_io_threads < 1:
+            raise ValueError("cas_io_threads must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
 class NodeConfig:
     """Per-node runtime configuration."""
 
@@ -200,6 +239,9 @@ class NodeConfig:
     # read-path serving tier (cache / coalescing / shedding / readahead);
     # default ServeConfig() disables every component
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    # write-path pipeline bounds (window / credits / per-peer slices);
+    # IngestConfig(window=1, slice_inflight=1) = the serial write path
+    ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
 
     @property
     def self_addr(self) -> PeerAddr:

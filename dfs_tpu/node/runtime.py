@@ -26,7 +26,10 @@ from __future__ import annotations
 
 import asyncio
 import math
+import threading
+import time
 import types
+from collections import deque
 from typing import Mapping, Sequence
 
 from dfs_tpu.comm.rpc import InternalClient, RpcError, RpcUnreachable
@@ -40,10 +43,12 @@ from dfs_tpu.node.health import HealthMonitor
 from dfs_tpu.node.placement import (ec_shard_node, handoff_order,
                                     replica_set)
 from dfs_tpu.serve import BatchPrefetcher, ServingTier
+from dfs_tpu.store.aio import AsyncChunkStore
 from dfs_tpu.store.cas import NodeStore
 from dfs_tpu.utils.hashing import (is_hex_digest, sha256_hex,
                                    sha256_many_hex)
-from dfs_tpu.utils.logging import Counters, get_logger
+from dfs_tpu.utils.aio import gather_abort_siblings
+from dfs_tpu.utils.logging import Counters, Stopwatches, get_logger
 from dfs_tpu.utils.trace import LatencyRecorder, span
 
 
@@ -147,10 +152,61 @@ def ec_shard_items(manifest: Manifest) -> list[tuple[str, int]]:
 _HEAVY_OPS = frozenset({"store_chunks", "get_chunk", "get_chunks"})
 
 
+class ByteBudget:
+    """Counting BYTE semaphore for cross-thread ingest backpressure.
+
+    The streaming-upload credit gate originally bounded chunk COUNT
+    (256), which bounds memory only as well as the chunk-size config
+    does: a stream of max-size chunks under a large ``max_chunk`` could
+    buffer ~1 GiB of produced-but-unconsumed payloads, silently breaking
+    the bounded-memory ingest contract. This gate charges actual payload
+    bytes instead.
+
+    A single chunk larger than the whole budget is admitted when nothing
+    else is outstanding (otherwise it could never proceed — the classic
+    byte-semaphore deadlock); the budget is then simply oversubscribed
+    by that one chunk until it is consumed.
+    """
+
+    def __init__(self, budget: int) -> None:
+        self.budget = max(1, int(budget))
+        self._out = 0
+        self._cv = threading.Condition()
+
+    def acquire(self, n: int, timeout: float | None = None) -> bool:
+        """Block until ``n`` bytes fit under the budget (or the gate is
+        empty); False on timeout. Called from the fragmenter thread."""
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: self._out + n <= self.budget or self._out == 0,
+                timeout)
+            if ok:
+                self._out += n
+            return ok
+
+    def release(self, n: int) -> None:
+        with self._cv:
+            self._out = max(0, self._out - n)
+            self._cv.notify_all()
+
+    @property
+    def outstanding(self) -> int:
+        with self._cv:
+            return self._out
+
+
 class StorageNodeServer:
     def __init__(self, cfg: NodeConfig) -> None:
         self.cfg = cfg
         self.store = NodeStore(cfg.data_root, cfg.node_id)
+        # async CAS tier: every event-loop chunk put/get routes through a
+        # bounded thread pool (store/aio.py) — the loop never blocks on
+        # chunk file I/O and disk concurrency is explicit
+        self.cas = AsyncChunkStore(self.store.chunks,
+                                   workers=cfg.ingest.cas_io_threads)
+        # streaming-ingest flush size: config-driven, kept as an instance
+        # attribute so tests/benches can still scale it per node
+        self._STREAM_FLUSH_BYTES = cfg.ingest.flush_bytes
         if cfg.sidecar_port:
             # delegate chunk+hash to a sidecar process (north-star shape:
             # device init/compiles never block the serving loop)
@@ -169,6 +225,9 @@ class StorageNodeServer:
                                     probe_interval_s=cfg.health_probe_s)
         self.counters = Counters()
         self.latency = LatencyRecorder()
+        # write-path stall attribution (time blocked on credits vs
+        # replication vs disk) + pipeline-depth peaks — /metrics "ingest"
+        self.ingest_stalls = Stopwatches()
         # read-path serving tier: hot-chunk cache + single-flight +
         # admission gates + readahead. Default config = every component
         # off, and the node runs the historical code paths exactly.
@@ -199,6 +258,7 @@ class StorageNodeServer:
     async def stop(self) -> None:
         self.health.stop()
         self.client.close()   # drop pooled peer connections
+        self.cas.close()      # async CAS tier workers (non-blocking)
         # Peers keep POOLED connections into this node open indefinitely;
         # Server.wait_closed() (3.12+) waits for every live handler, so
         # idle inbound connections must be torn down explicitly or stop()
@@ -311,7 +371,10 @@ class StorageNodeServer:
         if op == "list_manifests":
             return {"ok": True, "ids": self.store.manifests.ids()}, b""
         if op == "get_chunk":
-            data = self.store.chunks.get(header["digest"])
+            # off-loop via the bounded CAS pool: a cold read under
+            # writeback pressure is a multi-ms (worst observed: multi-s)
+            # syscall the serving loop must not eat inline
+            data = await self.cas.get(header["digest"])
             if data is None:
                 return {"ok": False, "error": "chunk not found"}, b""
             return {"ok": True}, data
@@ -320,10 +383,10 @@ class StorageNodeServer:
             # node holds (the per-chunk op costs a full RPC round-trip per
             # chunk — the dominant cost of degraded reads at small chunk
             # sizes). Missing digests are simply absent from the table.
-            digests = header.get("digests", [])
-            have = await asyncio.to_thread(
-                lambda: [(d, b) for d in digests
-                         if (b := self.store.chunks.get(d)) is not None])
+            # Reads ride the bounded CAS pool like every other chunk-file
+            # touch — a burst of peer batched fetches must not stack
+            # unbounded executor jobs.
+            have = await self.cas.get_many(header.get("digests", []))
             table, body = pack_chunks(have)
             return {"ok": True, "chunks": table}, body
         if op == "get_manifest":
@@ -443,20 +506,33 @@ class StorageNodeServer:
         ec = EcInfo(k=k, stripes=tuple(stripes))
         return _dc.replace(manifest, ec=ec), parity
 
-    _STREAM_FLUSH_BYTES = 32 * 1024 * 1024
+    # per-RPC payload cap for replication slices (see replicate() in
+    # _place_batch); class-level so tests/benches can scale it per node
+    _REPLICA_SLICE_BYTES = 8 * 1024 * 1024
 
     async def upload_stream(self, blocks, name: str) -> tuple[Manifest, dict]:
-        """Bounded-memory ingest: ``blocks`` is an async iterator of byte
-        blocks (e.g. an HTTP chunked-transfer body). The fragmenter's
-        pipelined streaming walk runs in a worker thread consuming the
-        blocks; finished chunks flow back and are placed/replicated in
-        ~32 MiB batches as the stream arrives — at no point does the
-        whole payload exist in node memory (the reference reads the
-        entire body into one array, StorageNode.java:124). file_id stays
-        sha256(whole stream), computed incrementally."""
+        """Bounded-memory PIPELINED ingest: ``blocks`` is an async
+        iterator of byte blocks (e.g. an HTTP chunked-transfer body).
+        The fragmenter's streaming walk runs in a worker thread
+        consuming the blocks; finished chunks flow back and are
+        placed/replicated in ~``ingest.flush_bytes`` batches as the
+        stream arrives — at no point does the whole payload exist in
+        node memory (the reference reads the entire body into one array,
+        StorageNode.java:124). file_id stays sha256(whole stream),
+        computed incrementally.
+
+        Up to ``ingest.window`` placement batches stay in flight at once
+        (docs/ingest.md): while batch N replicates over the network the
+        fragmenter keeps chunking batch N+1 instead of stalling on its
+        credits — replication latency was the dominant ingest cost the
+        serial schedule paid in full (INGEST_r07.json: 2.66x). The first
+        placement failure aborts the stream exactly like the serial
+        path: reading stops, no manifest commits, already-placed chunks
+        age out via GC. Per-batch stats are kept separately and merged
+        in batch order, so the windowed schedule reports byte-identical
+        stats to the serial one."""
         import hashlib
         import queue as _queue
-        import threading
 
         loop = asyncio.get_running_loop()
         inq: _queue.Queue = _queue.Queue(maxsize=4)
@@ -464,24 +540,43 @@ class StorageNodeServer:
         hasher = hashlib.sha256()
         frag_dead = threading.Event()
         aborted = threading.Event()
-        # chunk credits: the fragmenter thread blocks once this many
-        # produced chunks are unconsumed, which stops it draining inq,
-        # which blocks the feeder, which stops reading the socket — TCP
-        # backpressure end to end. Without it a fast client outruns slow
-        # replication and the 'bounded-memory' contract silently fails.
-        credits = threading.Semaphore(256)
+        # byte credits: the fragmenter thread blocks once this many
+        # produced-but-unconsumed payload BYTES are outstanding, which
+        # stops it draining inq, which blocks the feeder, which stops
+        # reading the socket — TCP backpressure end to end. Without it a
+        # fast client outruns slow replication and the 'bounded-memory'
+        # contract silently fails. (Counting chunks instead of bytes —
+        # the gate until round 7 — let max-size chunks oversubscribe the
+        # budget by orders of magnitude.)
+        credits = ByteBudget(self.cfg.ingest.credit_bytes)
 
         def feed_iter():
             while True:
-                b = inq.get()
+                try:
+                    b = inq.get(timeout=0.5)
+                except _queue.Empty:
+                    # abort must not depend on the end-of-stream sentinel
+                    # arriving: the feeder's cancelled finally submits it
+                    # through the shared to_thread pool, which can be
+                    # saturated — a fragmenter parked in a bare get()
+                    # would deadlock the abort path's gather forever
+                    if aborted.is_set():
+                        return
+                    continue
                 if b is None:
                     return
                 yield b
 
         def on_chunk(digest: str, payload: bytes) -> None:
-            while not credits.acquire(timeout=0.5):
+            t0 = time.perf_counter()
+            while not credits.acquire(len(payload), timeout=0.5):
                 if aborted.is_set():
                     raise RuntimeError("upload aborted")
+            waited = time.perf_counter() - t0
+            if waited > 0.001:   # stall attribution: chunking blocked on
+                # unconsumed output (downstream placement is the
+                # bottleneck); sub-ms lock noise is not a stall
+                self.ingest_stalls.add("creditS", waited)
             loop.call_soon_threadsafe(outq.put_nowait, (digest, payload))
 
         def run_fragmenter():
@@ -528,31 +623,108 @@ class StorageNodeServer:
         batch: list[tuple[str, bytes]] = []
         pending = 0
         manifest: Manifest | None = None
+        window = max(1, self.cfg.ingest.window)
+        # (task, per-batch stats) in submission order — awaited FIFO so
+        # stats merge deterministically and the FIRST failing batch is
+        # the one that aborts the stream
+        inflight: deque[tuple[asyncio.Task, dict]] = deque()
+
+        async def drain_one() -> None:
+            task, bstats = inflight[0]
+            # removed only AFTER the await resolves: if THIS coroutine
+            # is cancelled mid-await (client hung up), the still-running
+            # placement must remain in `inflight` so the abort path
+            # below cancels and reaps it — popping first leaked it
+            await task
+            inflight.popleft()
+            self._merge_upload_stats(stats, bstats)
+
+        async def submit(b: list[tuple[str, bytes]]) -> None:
+            if window == 1:     # serial placement: the historical
+                # schedule, byte-identical behavior
+                await self._place_batch("", b, stats)
+                return
+            while len(inflight) >= window:
+                # stall attribution: the window is full — ingest is
+                # blocked on placement (replication/disk), not chunking
+                t0 = time.perf_counter()
+                # surface a failure from ANY in-flight batch before
+                # blocking: awaiting only the head would ride out a
+                # slow batch A (dead-peer retries run tens of seconds)
+                # while batch C's failure is already known — and then
+                # replicate one more doomed batch
+                for task, _ in inflight:
+                    if task.done() and not task.cancelled() \
+                            and task.exception() is not None:
+                        await task          # re-raise: abort the stream
+                if inflight[0][0].done():
+                    await drain_one()       # FIFO merge
+                else:
+                    await asyncio.wait(
+                        [t for t, _ in inflight if not t.done()],
+                        return_when=asyncio.FIRST_COMPLETED)
+                self.ingest_stalls.add("placementS",
+                                       time.perf_counter() - t0)
+            bstats = self._new_upload_stats()
+            task = asyncio.create_task(self._place_batch("", b, bstats))
+            # completion wakes the consume loop below via a sentinel: a
+            # FAILED placement must abort the stream even while the
+            # consumer is parked on outq behind a slow client — without
+            # the wakeup, abort latency was coupled to body progress
+            task.add_done_callback(
+                lambda t: outq.put_nowait(("placed", t)))
+            inflight.append((task, bstats))
+            self.ingest_stalls.peak("placeWindow", len(inflight))
+
         # file_id is only known at stream end; batches placed before that
         # tag transfers with a placeholder (store_chunks ignores it)
         try:
             while manifest is None:
+                # merge (and surface failures of) any placements that
+                # already resolved, oldest first
+                while inflight and inflight[0][0].done():
+                    await drain_one()
                 item = await outq.get()
+                if item[0] == "placed":
+                    task = item[1]
+                    if not task.cancelled() and task.exception() \
+                            is not None:
+                        await task   # re-raise the placement failure
+                        # NOW — reading the body stops immediately
+                    continue         # success: head drain above merges
                 if item[0] == "error" and isinstance(item[1], BaseException):
                     raise UploadError(f"fragmenter failed: {item[1]}")
                 if item[0] == "done" and isinstance(item[1], Manifest):
                     manifest = item[1]
                     break
-                credits.release()
                 digest, payload = item
+                credits.release(len(payload))
                 if digest in seen:
                     continue
                 seen.add(digest)
                 batch.append((digest, payload))
                 pending += len(payload)
                 if pending >= self._STREAM_FLUSH_BYTES:
-                    await self._place_batch("", batch, stats)
+                    await submit(batch)
                     batch, pending = [], 0
             if batch:
-                await self._place_batch("", batch, stats)
+                await submit(batch)
+            while inflight:        # tail drain: the stream is chunked,
+                t0 = time.perf_counter()   # only placement remains
+                await drain_one()
+                self.ingest_stalls.add("placementS",
+                                       time.perf_counter() - t0)
         except BaseException:
             aborted.set()                  # unblock fragmenter + feeder
+            # the feeder may be parked in a socket read with no timeout
+            # (a stalled client mid-body) — cancel it rather than wait
+            # for the next block that may never come; its finally still
+            # hands the fragmenter the end-of-stream sentinel
+            feed_task.cancel()
+            for task, _ in inflight:       # first failure aborts: stop
+                task.cancel()              # sibling placements too
             await asyncio.gather(feed_task, frag_task,
+                                 *(t for t, _ in inflight),
                                  return_exceptions=True)
             raise
         try:
@@ -703,12 +875,30 @@ class StorageNodeServer:
                 "handoffChunks": 0, "degraded": False}
 
     @staticmethod
-    def _slice_payloads(items: list[tuple[str, bytes]],
-                        max_bytes: int = 8 * 1024 * 1024
+    def _merge_upload_stats(into: dict, part: dict) -> None:
+        """Fold one batch's placement stats into the stream totals.
+        Every field is commutative (sum / min / or), so the windowed
+        schedule reports exactly what the serial one would; merging in
+        batch order anyway keeps the trace reproducible. ``bytes`` and
+        ``uniqueChunks`` are stream-level — set by the caller at stream
+        end, never by a batch."""
+        into["transferredBytes"] += part["transferredBytes"]
+        into["dedupSkippedBytes"] += part["dedupSkippedBytes"]
+        into["handoffChunks"] += part["handoffChunks"]
+        into["degraded"] = into["degraded"] or part["degraded"]
+        if part["minCopies"] is not None:
+            into["minCopies"] = part["minCopies"] \
+                if into["minCopies"] is None \
+                else min(into["minCopies"], part["minCopies"])
+
+    @staticmethod
+    def _slice_payloads(items: list[tuple[str, bytes]], max_bytes: int
                         ) -> list[list[tuple[str, bytes]]]:
         """Split (digest, payload) lists into <= max_bytes slices (always
         at least one item per slice) so no single RPC carries unbounded
-        bytes — the receiver hash-echoes a whole call before replying."""
+        bytes — the receiver hash-echoes a whole call before replying.
+        ``max_bytes`` is required: callers pass ``_REPLICA_SLICE_BYTES``
+        (instance-scalable) so a default here cannot silently drift."""
         out: list[list[tuple[str, bytes]]] = []
         cur: list[tuple[str, bytes]] = []
         size = 0
@@ -755,19 +945,41 @@ class StorageNodeServer:
         per_node: dict[int, list[tuple[str, bytes]]] = {}
         copies: dict[str, int] = {}
         payload_of: dict[str, bytes] = {}
+        local_puts: list[tuple[str, bytes]] = []
         for digest, payload in batch:
             copies[digest] = 0
             payload_of[digest] = payload
             for target in primary_targets(digest):
                 if target == self.cfg.node_id:
-                    if self.store.chunks.put(digest, payload, verify=False):
-                        self.counters.inc("chunks_stored")
-                        self.counters.inc("bytes_stored", len(payload))
-                    else:
-                        self.counters.inc("dedup_hits")
+                    local_puts.append((digest, payload))
                     copies[digest] += 1
                 else:
                     per_node.setdefault(target, []).append((digest, payload))
+
+        async def put_local(items: list[tuple[str, bytes]],
+                            count_dedup: bool = True) -> None:
+            # local canonical copies through the async CAS tier: one
+            # bounded-pool job for the whole list, OFF the event loop
+            # (inline puts occupied it for the full writeback pass) and
+            # overlapping peer replication instead of preceding it. A
+            # failed put still fails the batch via the gather below.
+            results = await self.cas.put_many(items, verify=False)
+            nstored = nbytes = 0
+            for (d, b), newly in zip(items, results):
+                if newly:
+                    nstored += 1
+                    nbytes += len(b)
+            if nstored:
+                self.counters.inc("chunks_stored", nstored)
+                self.counters.inc("bytes_stored", nbytes)
+            if count_dedup and len(items) > nstored:
+                self.counters.inc("dedup_hits", len(items) - nstored)
+
+        # (peer, digest) pairs whose bytes are already accounted in
+        # transferredBytes/dedupSkippedBytes: a chunk's bytes count at
+        # most ONCE per peer across the primary and handoff passes, so
+        # repeated handoff probes cannot double-count one transfer
+        counted: set[tuple[int, str]] = set()
 
         async def replicate(node_id: int,
                             wanted: list[tuple[str, bytes]]) -> None:
@@ -777,35 +989,74 @@ class StorageNodeServer:
             # envelope (health registry, SURVEY.md §5.3).
             retries = None if self.health.is_alive(node_id) else 1
             try:
-                resp, _ = await self.client.call(
+                # the has_chunks probe flies while the payload list is
+                # staged into bounded slices — fresh data rarely dedups,
+                # so the optimistic staging is usually final; a dedup
+                # hit restages only the missing remainder
+                probe = asyncio.create_task(self.client.call(
                     peer, {"op": "has_chunks", "digests": digests},
-                    retries=retries)
+                    retries=retries))
+                try:
+                    # staging runs on a worker thread so it is GENUINELY
+                    # concurrent with the probe's RTT: the to_thread
+                    # await yields the loop, which runs the probe task's
+                    # send before (and while) the slicing executes —
+                    # inline staging after create_task would still
+                    # serialize ahead of the wire write
+                    staged = await asyncio.to_thread(
+                        self._slice_payloads, wanted,
+                        self._REPLICA_SLICE_BYTES)
+                    resp, _ = await probe
+                except BaseException:
+                    probe.cancel()   # replicate cancelled/failed first:
+                    raise            # don't orphan the probe task
                 have = set(resp.get("have", []))
                 missing = [(d, b) for d, b in wanted if d not in have]
                 for d, b in wanted:
                     if d in have:
-                        stats["dedupSkippedBytes"] += len(b)
-                        self.counters.inc("dedup_remote_hits")
+                        # durable on the peer no matter what later
+                        # slices do — credit the copy immediately
+                        copies[d] += 1
+                        if (node_id, d) not in counted:
+                            counted.add((node_id, d))
+                            stats["dedupSkippedBytes"] += len(b)
+                            self.counters.inc("dedup_remote_hits")
                 if missing:
                     # bounded RPCs: the receiver recomputes the hash echo
                     # of everything in one call before replying, so an
                     # unbounded payload turns into an unbounded server
                     # pass — a ~300 MB push under 1-core contention blew
                     # the request timeout and failed a whole 2 GiB-corpus
-                    # upload below quorum; <=32 MiB slices keep each
+                    # upload below quorum; bounded slices keep each
                     # call's work (and any retry's re-send) small
-                    for part in self._slice_payloads(missing):
-                        echoed = await self.client.store_chunks(
-                            peer, file_id, part)
+                    slices = staged if not have else \
+                        self._slice_payloads(missing,
+                                             self._REPLICA_SLICE_BYTES)
+
+                    def on_slice(part: list[tuple[str, bytes]],
+                                 echoed: list[str]) -> None:
+                        # hash-echo verification per slice (reference
+                        # contract, StorageNode.java:248-257) + per-slice
+                        # crediting: a verified slice is durable on the
+                        # peer even if a LATER slice fails — end-of-call
+                        # crediting forgot delivered bytes on partial
+                        # failure, and handoff re-transferred (and
+                        # re-counted) them
                         sent = {d for d, _ in part}
-                        verified = sent & set(echoed)
-                        if verified != sent:
+                        if sent & set(echoed) != sent:
                             raise RpcError(
                                 f"hash echo mismatch from node {node_id}")
-                        stats["transferredBytes"] += sum(
-                            len(b) for _, b in part)
-                for d in digests:
-                    copies[d] += 1
+                        for d, b in part:
+                            copies[d] += 1
+                            if (node_id, d) not in counted:
+                                counted.add((node_id, d))
+                                stats["transferredBytes"] += len(b)
+
+                    peak = await self.client.store_chunks_windowed(
+                        peer, file_id, slices,
+                        window=self.cfg.ingest.slice_inflight,
+                        on_slice=on_slice)
+                    self.ingest_stalls.peak("sliceInflight", peak)
                 self.health.mark_alive(node_id)
             except RpcError as e:
                 self.log.warning("replication to node %d failed: %s",
@@ -817,8 +1068,9 @@ class StorageNodeServer:
                     self.health.mark_dead(node_id)
 
         with span("upload.replicate", self.latency):
-            await asyncio.gather(*(replicate(nid, w)
-                                   for nid, w in per_node.items()))
+            await gather_abort_siblings(
+                put_local(local_puts),
+                *(replicate(nid, w) for nid, w in per_node.items()))
 
         # Sloppy-quorum fallback (hinted handoff): chunks still below
         # quorum try the next nodes in their digest ring, so a dead
@@ -842,6 +1094,7 @@ class StorageNodeServer:
                 if not need:
                     break
                 groups: dict[int, list[tuple[str, bytes]]] = {}
+                local_handoff: list[tuple[str, bytes]] = []
                 progress = False
                 for d in need:
                     order = handoff_ring(d)
@@ -852,20 +1105,23 @@ class StorageNodeServer:
                     progress = True
                     handoff.add(d)
                     if target == self.cfg.node_id:
-                        if self.store.chunks.put(d, payload_of[d],
-                                                 verify=False):
-                            self.counters.inc("chunks_stored")
-                            self.counters.inc("bytes_stored",
-                                              len(payload_of[d]))
+                        local_handoff.append((d, payload_of[d]))
                         copies[d] += 1   # local copy counts even on dedup
                     else:
                         groups.setdefault(target, []).append(
                             (d, payload_of[d]))
                 if not progress:
                     break
-                if groups:
-                    await asyncio.gather(*(replicate(nid, w)
-                                           for nid, w in groups.items()))
+                jobs = []
+                if local_handoff:
+                    # count_dedup=False: the handoff path never counted
+                    # a local dedup hit (the copy was credited above)
+                    jobs.append(put_local(local_handoff,
+                                          count_dedup=False))
+                jobs.extend(replicate(nid, w)
+                            for nid, w in groups.items())
+                if jobs:
+                    await gather_abort_siblings(*jobs)
 
         # Write-quorum policy (vs reference write-all abort, :218-221).
         failed = [d for d, n in copies.items() if n < quorum]
@@ -910,7 +1166,9 @@ class StorageNodeServer:
     # ------------------------------------------------------------------ #
 
     async def _fetch_chunk(self, digest: str, length: int) -> bytes:
-        data = self.store.chunks.get(digest)
+        # local read through the bounded CAS pool — never inline on the
+        # event loop (same rule every other chunk-file touch follows)
+        data = await self.cas.get(digest)
         if data is not None:
             return data
         ids = self.cfg.cluster.sorted_ids()
@@ -970,9 +1228,14 @@ class StorageNodeServer:
         out: dict[str, bytes] = {}
         for d in list(need):
             b = (prefetched or {}).get(d)
-            if b is None:
-                b = self.store.chunks.get(d)
             if b is not None:
+                out[d] = b
+                del need[d]
+        if need:
+            # local reads batched through the async CAS tier: one
+            # bounded-pool job instead of one inline open/read per chunk
+            # on the event loop
+            for d, b in await self.cas.get_many(list(need)):
                 out[d] = b
                 del need[d]
         if not need:
@@ -1471,9 +1734,7 @@ class StorageNodeServer:
         evicted + queued for repair and re-fetched from replicas, the
         same discipline range reads use)."""
         digests = list(dict.fromkeys(c.digest for c in chunks))
-        local = await asyncio.to_thread(
-            lambda: [(d, b) for d in digests
-                     if (b := self.store.chunks.get(d)) is not None])
+        local = await self.cas.get_many(digests)
         hexes = await asyncio.to_thread(
             sha256_many_hex, [b for _, b in local])
         good: dict[str, bytes] = {}
@@ -1597,6 +1858,20 @@ class StorageNodeServer:
     # ------------------------------------------------------------------ #
     # listing (reference handleListFiles, StorageNode.java:364-393)
     # ------------------------------------------------------------------ #
+
+    def ingest_stats(self) -> dict:
+        """Write-path pipeline observability for /metrics: the configured
+        bounds plus stall attribution — where ingest wall time went
+        (chunking blocked on credits vs placement blocked on
+        replication vs the disk tier's queue/busy split) and the peak
+        pipeline depths actually reached."""
+        ing = self.cfg.ingest
+        return {"window": ing.window,
+                "flushBytes": self._STREAM_FLUSH_BYTES,
+                "creditBytes": ing.credit_bytes,
+                "sliceInflight": ing.slice_inflight,
+                "stalls": self.ingest_stalls.snapshot(),
+                "cas": self.cas.stats()}
 
     def list_files(self) -> list[dict]:
         return [{"fileId": m.file_id, "name": m.name, "size": m.size,
@@ -1856,8 +2131,11 @@ class StorageNodeServer:
                     # (StorageNode.java:248-257): only echoed digests
                     # count. Bounded slices like upload's replicate — a
                     # repair push after a big membership change can carry
-                    # most of a corpus.
-                    for part in self._slice_payloads(payload):
+                    # most of a corpus. Serial slices on purpose: repair
+                    # is background work and must not compete with live
+                    # ingest for per-peer bandwidth.
+                    for part in self._slice_payloads(
+                            payload, self._REPLICA_SLICE_BYTES):
                         echoed = set(await self.client.store_chunks(
                             peer, "", part))
                         ok = {d for d, _ in part} & echoed

@@ -33,6 +33,23 @@ from dfs_tpu.ops.gear_jax import HALO, WINDOW
 from dfs_tpu.ops.sha256_jax import _sha256_blocks_impl
 
 
+def _shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` across the API move: newer releases export the
+    stable top-level name, older ones only ``jax.experimental``'s; the
+    replication-check flag was renamed check_rep -> check_vma along the
+    way (and some releases have the top-level name but the OLD flag
+    spelling, so the flag is chosen by signature, not by location)."""
+    import inspect
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+    flag = "check_vma" \
+        if "check_vma" in inspect.signature(fn).parameters else "check_rep"
+    return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              **{flag: check_vma})
+
+
 def _rowwise_gear_bitmap(data: jax.Array, prev_g: jax.Array,
                          table: jax.Array, mask: jax.Array) -> jax.Array:
     """data: [B, S] uint8; prev_g: [B, 31] uint32 (halo per row)."""
@@ -71,7 +88,7 @@ def make_sharded_step(mesh: Mesh, table: np.ndarray, mask: int):
             jax.lax.psum(jnp.sum(bitmap.astype(jnp.int32)), "sp"), "dp")
         return bitmap, state, n_cand
 
-    shard_fn = jax.shard_map(
+    shard_fn = _shard_map(
         local_step, mesh=mesh,
         in_specs=(P("dp", "sp"), P(("dp", "sp")), P(("dp", "sp"))),
         out_specs=(P("dp", "sp"), P(("dp", "sp")), P()),
@@ -127,7 +144,7 @@ def make_aligned_step(mesh: Mesh, params):
             jax.lax.psum(jnp.sum(cf32), "sp"), "dp")
         return cf32, states, n
 
-    shard_fn = jax.shard_map(
+    shard_fn = _shard_map(
         local_step, mesh=mesh,
         in_specs=(P(("dp", "sp")), P(("dp", "sp"))),
         out_specs=(P(None, ("dp", "sp")), P(None, ("dp", "sp")), P()),
@@ -176,7 +193,7 @@ def make_anchored_anchor_step(mesh: Mesh, params, m_local: int):
                                   dev * jnp.int32(m_local * 4),
                                   0))[None, :, :]
 
-    shard_fn = jax.shard_map(
+    shard_fn = _shard_map(
         local_step, mesh=mesh,
         in_specs=(P(("dp", "sp"), None),),
         out_specs=P(("dp", "sp"), None, None),
@@ -241,7 +258,7 @@ def make_anchored_step(mesh: Mesh, params):
         n = jax.lax.psum(jax.lax.psum(jnp.sum(cf32), "sp"), "dp")
         return cf32, since, states, n
 
-    shard_fn = jax.shard_map(
+    shard_fn = _shard_map(
         local_step, mesh=mesh,
         in_specs=(P(), P(("dp", "sp")), P(("dp", "sp")), P(("dp", "sp")),),
         out_specs=(P(None, ("dp", "sp")), P(None, ("dp", "sp")),
@@ -501,7 +518,7 @@ def make_ec_step(mesh: Mesh, k: int):
             "sp"), "dp")
         return p, q, nbytes
 
-    shard_fn = jax.shard_map(
+    shard_fn = _shard_map(
         local_step, mesh=mesh,
         in_specs=(P(("dp", "sp")),),
         out_specs=(P(("dp", "sp")), P(("dp", "sp")), P()),

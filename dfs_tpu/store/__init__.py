@@ -1,1 +1,2 @@
 from dfs_tpu.store.cas import ChunkStore, ManifestStore, NodeStore  # noqa: F401
+from dfs_tpu.store.aio import AsyncChunkStore  # noqa: F401

@@ -1,0 +1,134 @@
+"""Async front of the local CAS (the write-path "disk tier").
+
+Every ``ChunkStore`` operation is blocking file I/O; called inline from
+the node's asyncio runtime it occupies the event loop for the syscall's
+duration — under writeback pressure that measured multi-second stalls
+during which the node answered nothing (the store_chunks receive path
+learned this first, runtime._dispatch). This wrapper runs chunk
+put/get through a small dedicated thread pool so
+
+- the event loop never blocks on chunk file I/O, and
+- disk concurrency is BOUNDED (``IngestConfig.cas_io_threads``) instead
+  of riding the unbounded default ``asyncio.to_thread`` executor, which
+  let a burst of concurrent reads stack arbitrary many file descriptors
+  and seeks.
+
+Batch variants (:meth:`put_many` / :meth:`get_many`) run a whole list in
+ONE worker job — per-chunk executor dispatch costs a lock+wakeup per
+item, which at CDC chunk sizes (thousands of chunks per batch) is real
+time on the 1-core CI host.
+
+The wrapper also attributes time: ``queue_s`` (submitted jobs waiting
+for a free worker — the disk tier is saturated) vs ``busy_s`` (actual
+I/O), surfaced under ``/metrics`` ``ingest.cas`` for the write-path
+stall breakdown (docs/ingest.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Sequence, TypeVar
+
+from dfs_tpu.store.cas import ChunkStore
+
+T = TypeVar("T")
+
+
+class AsyncChunkStore:
+    """Bounded-thread-pool async wrapper over one node's :class:`ChunkStore`.
+
+    Three lanes, because a batch job pins a worker for its whole list
+    (thousands of chunk files — multi-second under writeback pressure)
+    and FIFO queueing behind one would blow a peer RPC's budget, making
+    a merely BUSY node look dead to its callers — the same
+    probe-starvation failure the internal admission gate exempts health
+    ops to avoid:
+
+    - ``cas-w``: puts (ingest batches, handoff);
+    - ``cas-r``: batched reads (``get_many`` — degraded-read gathers);
+    - ``cas-g``: SINGLE-chunk gets (the peer-facing ``get_chunk``
+      dispatch and ``_fetch_chunk``), so the latency-critical path
+      never queues behind either batch lane.
+    """
+
+    def __init__(self, store: ChunkStore, workers: int = 4) -> None:
+        self.store = store
+        self._workers = max(1, int(workers))
+        self._wpool = ThreadPoolExecutor(
+            max_workers=self._workers, thread_name_prefix="cas-w")
+        self._rpool = ThreadPoolExecutor(
+            max_workers=self._workers, thread_name_prefix="cas-r")
+        self._gpool = ThreadPoolExecutor(
+            max_workers=max(2, self._workers // 2),
+            thread_name_prefix="cas-g")
+        self._lock = threading.Lock()
+        self._ops = 0
+        self._queue_s = 0.0
+        self._busy_s = 0.0
+
+    async def _run(self, pool: ThreadPoolExecutor,
+                   fn: Callable[[], T]) -> T:
+        import asyncio
+
+        t_submit = time.perf_counter()
+
+        def job() -> T:
+            t_start = time.perf_counter()
+            try:
+                return fn()
+            finally:
+                t_end = time.perf_counter()
+                with self._lock:
+                    self._ops += 1
+                    self._queue_s += t_start - t_submit
+                    self._busy_s += t_end - t_start
+
+        return await asyncio.get_running_loop().run_in_executor(pool, job)
+
+    async def get(self, digest: str) -> bytes | None:
+        return await self._run(self._gpool,
+                               lambda: self.store.get(digest))
+
+    async def put(self, digest: str, data: bytes,
+                  verify: bool = False) -> bool:
+        return await self._run(
+            self._wpool,
+            lambda: self.store.put(digest, data, verify=verify))
+
+    async def get_many(self, digests: Sequence[str]
+                       ) -> list[tuple[str, bytes]]:
+        """(digest, bytes) for every digest present locally — one worker
+        job for the whole list; absent digests are simply missing."""
+        if not digests:
+            return []
+        ds = list(digests)
+        return await self._run(
+            self._rpool,
+            lambda: [(d, b) for d in ds
+                     if (b := self.store.get(d)) is not None])
+
+    async def put_many(self, items: Sequence[tuple[str, bytes]],
+                       verify: bool = False) -> list[bool]:
+        """Store a batch; per-item True = newly stored (False = dedup
+        hit), same contract as :meth:`ChunkStore.put`, one worker job."""
+        if not items:
+            return []
+        its = list(items)
+        return await self._run(
+            self._wpool,
+            lambda: [self.store.put(d, b, verify=verify) for d, b in its])
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"workers": self._workers, "ops": self._ops,
+                    "queueS": round(self._queue_s, 6),
+                    "busyS": round(self._busy_s, 6)}
+
+    def close(self) -> None:
+        # wait=False: in-flight jobs finish on their worker threads, but
+        # an async stop() must not block its loop on the drain
+        self._wpool.shutdown(wait=False)
+        self._rpool.shutdown(wait=False)
+        self._gpool.shutdown(wait=False)

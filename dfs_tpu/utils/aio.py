@@ -1,0 +1,26 @@
+"""Small asyncio helpers shared across layers (jax-free)."""
+
+from __future__ import annotations
+
+import asyncio
+
+
+async def gather_abort_siblings(*coros):
+    """gather() that CANCELS the surviving coroutines when one raises.
+
+    A bare gather propagates the first exception but leaves its siblings
+    running detached — an error aborting one leg of concurrent work
+    (e.g. a local-disk failure in a placement batch) must also stop the
+    traffic it was gathered with, and must not leak pending tasks into a
+    closing loop. Shared by the node runtime's placement gathers and the
+    RPC layer's windowed slice sender — one copy of the idiom, not two
+    drifting ones.
+    """
+    tasks = [asyncio.ensure_future(c) for c in coros]
+    try:
+        return await asyncio.gather(*tasks)
+    except BaseException:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        raise

@@ -42,3 +42,31 @@ class Counters:
     def snapshot(self) -> dict[str, int]:
         with self._lock:
             return dict(self._c)
+
+
+class Stopwatches:
+    """Thread-safe float accumulators (seconds) plus peak gauges —
+    stall attribution for the pipelined write path (/metrics ``ingest``:
+    time blocked on credits vs replication vs disk, peak pipeline
+    depths). Counters are ints by design; durations and high-water marks
+    need floats/max semantics, hence a separate registry."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._s: dict[str, float] = defaultdict(float)
+        self._peak: dict[str, float] = {}
+
+    def add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._s[name] += seconds
+
+    def peak(self, name: str, value: float) -> None:
+        with self._lock:
+            if value > self._peak.get(name, float("-inf")):
+                self._peak[name] = value
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            out = {k: round(v, 6) for k, v in self._s.items()}
+            out.update({f"{k}Peak": v for k, v in self._peak.items()})
+            return out

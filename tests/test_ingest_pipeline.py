@@ -1,0 +1,335 @@
+"""Pipelined write path (docs/ingest.md): the byte-budget backpressure
+gate, the async CAS tier, per-peer windowed slice replication, the
+once-per-peer transfer accounting, and the tier-1 smoke mode of
+bench_ingest_pipeline.py (artifact schema + overlap engagement on every
+run — the committed INGEST_r07.json carries the perf claim)."""
+
+import asyncio
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dfs_tpu.comm.rpc import InternalClient, RpcError, RpcUnreachable
+from dfs_tpu.config import (CDCParams, ClusterConfig, IngestConfig,
+                            NodeConfig, PeerAddr)
+from dfs_tpu.node.runtime import ByteBudget, StorageNodeServer
+from dfs_tpu.store.aio import AsyncChunkStore
+from dfs_tpu.store.cas import ChunkStore
+from dfs_tpu.utils.hashing import sha256_hex
+
+REPO = Path(__file__).resolve().parent.parent
+CDC = CDCParams(min_size=64, avg_size=256, max_size=1024)
+
+
+# ---------------------------------------------------------------------- #
+# ByteBudget: byte-denominated backpressure
+# ---------------------------------------------------------------------- #
+
+def test_byte_budget_blocks_until_release():
+    b = ByteBudget(100)
+    assert b.acquire(60, timeout=0)
+    assert b.acquire(40, timeout=0)
+    assert not b.acquire(1, timeout=0.01)      # full: times out
+    order = []
+
+    def waiter():
+        assert b.acquire(50, timeout=5)
+        order.append("acquired")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    assert order == []                          # still blocked
+    b.release(60)
+    t.join(timeout=5)
+    assert order == ["acquired"]
+    assert b.outstanding == 90
+
+
+def test_byte_budget_admits_oversize_when_empty():
+    """One chunk larger than the whole budget must not deadlock: it is
+    admitted alone (budget oversubscribed until consumed)."""
+    b = ByteBudget(100)
+    assert b.acquire(500, timeout=0)            # empty gate: admitted
+    assert not b.acquire(1, timeout=0.01)       # now genuinely full
+    b.release(500)
+    assert b.outstanding == 0
+    assert b.acquire(1, timeout=0)
+
+
+def test_byte_budget_release_clamps_at_zero():
+    b = ByteBudget(10)
+    b.release(99)                               # spurious release
+    assert b.outstanding == 0
+    assert b.acquire(10, timeout=0)
+
+
+# ---------------------------------------------------------------------- #
+# AsyncChunkStore: the bounded CAS thread pool
+# ---------------------------------------------------------------------- #
+
+def test_async_chunk_store_roundtrip(tmp_path, rng):
+    store = ChunkStore(tmp_path / "chunks")
+    aio = AsyncChunkStore(store, workers=2)
+    payloads = [rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+                for n in (10, 1000, 5000)]
+    items = [(sha256_hex(p), p) for p in payloads]
+
+    async def run():
+        stored = await aio.put_many(items)
+        assert stored == [True, True, True]
+        again = await aio.put_many(items)       # dedup: nothing new
+        assert again == [False, False, False]
+        got = dict(await aio.get_many(
+            [d for d, _ in items] + ["0" * 64]))  # absent digest skipped
+        assert got == dict(items)
+        assert await aio.get("0" * 64) is None
+        assert await aio.get(items[0][0]) == payloads[0]
+        assert await aio.put(items[0][0], payloads[0]) is False
+
+    asyncio.run(run())
+    st = aio.stats()
+    assert st["workers"] == 2 and st["ops"] >= 5
+    assert st["busyS"] >= 0 and st["queueS"] >= 0
+    aio.close()
+
+
+# ---------------------------------------------------------------------- #
+# cluster helpers (same in-process idiom as test_node_cluster)
+# ---------------------------------------------------------------------- #
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _cluster_cfg(n, rf=2):
+    ports = _free_ports(2 * n)
+    return ClusterConfig(peers=tuple(
+        PeerAddr(node_id=i + 1, host="127.0.0.1", port=ports[2 * i],
+                 internal_port=ports[2 * i + 1]) for i in range(n)),
+        replication_factor=rf)
+
+
+async def _start(cluster, root, **kw):
+    nodes = {}
+    for p in cluster.peers:
+        cfg = NodeConfig(node_id=p.node_id, cluster=cluster,
+                         data_root=root, fragmenter="cdc", cdc=CDC,
+                         health_probe_s=0, **kw)
+        n = StorageNodeServer(cfg)
+        await n.start()
+        nodes[p.node_id] = n
+    return nodes
+
+
+# ---------------------------------------------------------------------- #
+# windowed slice replication (comm/rpc.py)
+# ---------------------------------------------------------------------- #
+
+def test_store_chunks_windowed_delivers_and_reports_peak(tmp_path, rng):
+    async def run():
+        cluster = _cluster_cfg(1, rf=1)
+        nodes = await _start(cluster, tmp_path)
+        try:
+            peer = cluster.peer(1)
+            client = InternalClient()
+            payloads = [rng.integers(0, 256, size=2000,
+                                     dtype=np.uint8).tobytes()
+                        for _ in range(8)]
+            slices = [[(sha256_hex(p), p)] for p in payloads]
+            done = []
+            peak = await client.store_chunks_windowed(
+                peer, "", slices, window=3,
+                on_slice=lambda part, echoed: done.append(
+                    (part[0][0], list(echoed))))
+            assert len(done) == 8
+            for d, echoed in done:              # hash echo round-trips
+                assert echoed == [d]
+            # every slice landed on the peer
+            for p in payloads:
+                assert nodes[1].store.chunks.has(sha256_hex(p))
+            assert peak >= 2                    # pipeline actually filled
+            client.close()
+        finally:
+            for n in nodes.values():
+                await n.stop()
+
+    asyncio.run(run())
+
+
+def test_store_chunks_windowed_callback_error_propagates(tmp_path, rng):
+    """An on_slice exception (the caller's hash-echo verdict) must cancel
+    the remaining in-flight slices and propagate — the serial path's
+    failure semantics."""
+    async def run():
+        cluster = _cluster_cfg(1, rf=1)
+        nodes = await _start(cluster, tmp_path)
+        try:
+            peer = cluster.peer(1)
+            client = InternalClient()
+            payloads = [rng.integers(0, 256, size=1000,
+                                     dtype=np.uint8).tobytes()
+                        for _ in range(6)]
+            slices = [[(sha256_hex(p), p)] for p in payloads]
+
+            def on_slice(part, echoed):
+                raise RpcError("verification failed (injected)")
+
+            with pytest.raises(RpcError, match="injected"):
+                await client.store_chunks_windowed(
+                    peer, "", slices, window=2, on_slice=on_slice)
+            client.close()
+        finally:
+            for n in nodes.values():
+                await n.stop()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------- #
+# transfer accounting: bytes counted at most once per peer, per-slice
+# crediting across primary + handoff passes
+# ---------------------------------------------------------------------- #
+
+def test_transfer_accounting_counts_once_per_peer(tmp_path, rng):
+    """Fail the SECOND slice to one peer mid-upload: the first slice's
+    chunks are echo-verified on that peer and must stay credited (no
+    handoff re-transfer of delivered bytes), and ``transferredBytes``
+    must equal the bytes that actually crossed the wire — each chunk at
+    most once per peer."""
+    data = rng.integers(0, 256, size=120_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = _cluster_cfg(3, rf=2)
+        # serial slices: deterministic first-slice-then-failure order
+        nodes = await _start(cluster, tmp_path,
+                             ingest=IngestConfig(slice_inflight=1))
+        try:
+            up = nodes[1]
+            up._REPLICA_SLICE_BYTES = 16 * 1024    # several slices/peer
+            orig = up.client.store_chunks
+            delivered: list[tuple[int, str, int]] = []
+            peer2_calls = {"n": 0}
+
+            async def flaky(peer, file_id, chunks):
+                if peer.node_id == 2:
+                    peer2_calls["n"] += 1
+                    if peer2_calls["n"] >= 2:
+                        raise RpcUnreachable("injected slice failure")
+                echoed = await orig(peer, file_id, chunks)
+                delivered.extend((peer.node_id, d, len(b))
+                                 for d, b in chunks)
+                return echoed
+
+            up.client.store_chunks = flaky
+            manifest, stats = await up.upload(data, "acct.bin")
+            # quorum held: slice-1 chunks kept their peer-2 credit,
+            # slice-2 chunks found copies via handoff
+            assert stats["minCopies"] >= 2
+            # nothing crossed the wire twice to the same peer…
+            pairs = [(nid, d) for nid, d, _ in delivered]
+            assert len(pairs) == len(set(pairs))
+            # …and the stat equals exactly the bytes that did cross it
+            assert stats["transferredBytes"] == sum(
+                ln for _, _, ln in delivered)
+
+            # re-upload the same payload with the fault healed: skipped
+            # + transferred must cover every remote copy exactly once
+            up.client.store_chunks = orig
+            _, stats2 = await up.upload(data, "acct.bin")
+            ids = cluster.sorted_ids()
+            from dfs_tpu.node.placement import replica_set
+            seen = {}
+            for c in manifest.chunks:
+                seen.setdefault(c.digest, c.length)
+            remote_total = sum(
+                ln * sum(1 for t in replica_set(d, ids, 2) if t != 1)
+                for d, ln in seen.items())
+            assert (stats2["transferredBytes"]
+                    + stats2["dedupSkippedBytes"]) == remote_total
+        finally:
+            for n in nodes.values():
+                await n.stop()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------- #
+# windowed ingest over a real cluster: equivalence + metrics surface
+# ---------------------------------------------------------------------- #
+
+def test_windowed_cluster_ingest_and_metrics(tmp_path, rng):
+    data = rng.integers(0, 256, size=400_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = _cluster_cfg(3, rf=2)
+        nodes = await _start(cluster, tmp_path,
+                             ingest=IngestConfig(window=3,
+                                                 flush_bytes=64 * 1024))
+        try:
+            async def blocks():
+                for off in range(0, len(data), 20_000):
+                    yield data[off:off + 20_000]
+
+            manifest, stats = await nodes[1].upload_stream(blocks(),
+                                                           "w.bin")
+            assert stats["minCopies"] >= 2
+            # download from a DIFFERENT node: replicated bytes intact
+            _, got = await nodes[3].download(manifest.file_id)
+            assert got == data
+            ing = nodes[1].ingest_stats()
+            assert ing["window"] == 3
+            assert ing["stalls"].get("placeWindowPeak", 0) >= 2
+            assert ing["cas"]["ops"] > 0
+        finally:
+            for n in nodes.values():
+                await n.stop()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------- #
+# tier-1 smoke: bench_ingest_pipeline --tiny exercises the overlap logic
+# and the artifact schema on every run
+# ---------------------------------------------------------------------- #
+
+def test_bench_ingest_pipeline_tiny(tmp_path):
+    out_path = tmp_path / "INGEST_tiny.json"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO)}
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench_ingest_pipeline.py"),
+         "--tiny", "--out", str(out_path)],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    art = json.loads(out_path.read_text())
+    # schema: the keys INGEST_r07.json (full mode) commits to
+    for key in ("metric", "round", "mode", "workload", "serial",
+                "windowed", "speedup", "byte_identical", "overlap", "ok"):
+        assert key in art, f"artifact missing {key!r}"
+    assert art["metric"] == "ingest_pipeline" and art["mode"] == "tiny"
+    assert art["byte_identical"] is True
+    assert art["ok"] is True
+    # the pipeline actually overlapped: batch window and per-peer slice
+    # window both filled beyond one
+    assert art["overlap"]["place_window_peak"] >= 2
+    assert art["overlap"]["slice_inflight_peak"] >= 2
+    for phase in ("serial", "windowed"):
+        assert art[phase]["seconds"] > 0
+        assert art[phase]["ingest"]["cas"]["ops"] > 0

@@ -83,9 +83,8 @@ class NodeClient:
         body instead of every byte (SURVEY §5.4). Returns the node's
         upload reply plus 'clientBytesSent'. Falls back to a plain
         upload if the node's fragmenter is not resume-describable."""
-        import hashlib
-
         from dfs_tpu.fragmenter.base import fragmenter_from_description
+        from dfs_tpu.utils.hashing import sha256_hex
 
         try:
             desc = self.chunking()
@@ -100,7 +99,7 @@ class NodeClient:
         provided = [(d, data[c.offset:c.offset + c.length])
                     for d, c in by_digest.items() if d in missing]
         meta = json.dumps({
-            "fileId": hashlib.sha256(data).hexdigest(),
+            "fileId": sha256_hex(data),
             "size": len(data),
             "chunks": [[c.offset, c.length, c.digest] for c in refs],
             "provided": [d for d, _ in provided]}).encode()

@@ -46,12 +46,19 @@ def cmd_serve(args) -> int:
         sidecar_port=args.sidecar_port,
         cdc=CDCParams(min_size=args.min_chunk, avg_size=args.avg_chunk,
                       max_size=args.max_chunk),
+        fixed_parts=args.fixed_parts,
+        connect_timeout_s=args.connect_timeout,
+        request_timeout_s=args.request_timeout,
+        retries=args.rpc_retries,
+        health_probe_s=args.probe_interval,
+        write_quorum=args.write_quorum,
         serve=ServeConfig(cache_bytes=args.cache_bytes,
                           readahead_batches=args.readahead,
                           download_slots=args.download_slots,
                           upload_slots=args.upload_slots,
                           internal_slots=args.internal_slots,
-                          queue_depth=args.queue_depth),
+                          queue_depth=args.queue_depth,
+                          retry_after_s=args.retry_after),
         ingest=IngestConfig(window=args.ingest_window,
                             flush_bytes=args.ingest_flush_bytes,
                             credit_bytes=args.ingest_credit_bytes,
@@ -59,6 +66,8 @@ def cmd_serve(args) -> int:
                             cas_io_threads=args.cas_io_threads))
 
     async def run() -> None:
+        from dfs_tpu.utils.aio import create_logged_task
+
         node = StorageNodeServer(cfg)
         await node.start()
         # strong refs: the event loop holds only weak task references, so
@@ -78,7 +87,11 @@ def cmd_serve(args) -> int:
                     except Exception as e:  # noqa: BLE001
                         node.log.warning("%s failed: %s", what, e)
 
-            tasks.append(asyncio.create_task(loop()))
+            # retained ref + exception-logging done-callback: the
+            # per-iteration catch above handles expected failures, the
+            # callback makes an UNexpected loop death visible instead of
+            # parking the exception on a task nobody ever awaits
+            tasks.append(create_logged_task(loop(), node.log, what))
 
         async def do_repair() -> None:
             n = await node.repair_once()
@@ -277,6 +290,26 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--min-chunk", type=int, default=2048)
     serve.add_argument("--avg-chunk", type=int, default=8192)
     serve.add_argument("--max-chunk", type=int, default=65536)
+    serve.add_argument("--fixed-parts", type=int, default=5,
+                       help="FixedFragmenter part count (reference "
+                            "parity: TOTAL_NODES=5)")
+    serve.add_argument("--connect-timeout", type=float, default=2.0,
+                       help="per-attempt peer connect timeout (s)")
+    serve.add_argument("--request-timeout", type=float, default=10.0,
+                       help="per-attempt peer request timeout (s); bulk "
+                            "transfers add a size-derived margin")
+    serve.add_argument("--rpc-retries", type=int, default=3,
+                       help="peer call attempts before a peer counts "
+                            "as unreachable")
+    serve.add_argument("--probe-interval", type=float, default=5.0,
+                       help="seconds between peer health probes; 0 = "
+                            "data-path feedback only (no probe loop)")
+    serve.add_argument("--write-quorum", type=int, default=2,
+                       help="copies (incl. local) an upload needs "
+                            "before it acknowledges")
+    serve.add_argument("--retry-after", type=float, default=1.0,
+                       help="Retry-After seconds advertised on 503 "
+                            "shed responses")
     serve.add_argument("--repair-interval", type=float, default=30.0)
     serve.add_argument("--scrub-interval", type=float, default=3600.0,
                        help="seconds between local integrity sweeps "

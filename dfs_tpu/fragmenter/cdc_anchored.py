@@ -108,19 +108,18 @@ class AnchoredCpuFragmenter(_AnchoredBase):
         self.stride = region_bytes - self.params.seg_max
 
     def chunk(self, data: bytes) -> list[ChunkRef]:
-        import hashlib
-
         from dfs_tpu.native import native_anchored_spans
+        from dfs_tpu.utils.hashing import sha256_hex
 
         arr = _to_u8(data)
         spans = native_anchored_spans(arr, self.params)
         if spans is not None:
-            # digests via hashlib over zero-copy memoryview slices:
-            # OpenSSL's SHA-NI path measured 5x the portable C++ batch
+            # digests over zero-copy memoryview slices (sha256_hex
+            # passes them straight to OpenSSL's SHA-NI path, which
+            # measured 5x the portable C++ batch)
             mv = memoryview(np.ascontiguousarray(arr))
             return [ChunkRef(index=i, offset=int(o), length=int(ln),
-                             digest=hashlib.sha256(
-                                 mv[o:o + ln]).hexdigest())
+                             digest=sha256_hex(mv[o:o + ln]))
                     for i, (o, ln) in enumerate(spans)]
         out = chunk_file_anchored_np(arr, self.params)
         return [ChunkRef(index=i, offset=o, length=ln, digest=dg)
@@ -153,7 +152,7 @@ class AnchoredCpuFragmenter(_AnchoredBase):
         Peak memory ~ one window regardless of stream length; the
         reference reads the whole body into one array
         (StorageNode.java:124)."""
-        import hashlib
+        from dfs_tpu.utils.hashing import sha256_hex
 
         buf = bytearray()
         buf_base = 0                    # absolute offset of buf[0]
@@ -168,7 +167,7 @@ class AnchoredCpuFragmenter(_AnchoredBase):
             for o, ln in spans:
                 off = b0 + o
                 payload = bytes(buf[off - buf_base:off - buf_base + ln])
-                dg = hashlib.sha256(payload).hexdigest()
+                dg = sha256_hex(payload)
                 out.append(ChunkRef(index=idx, offset=off, length=ln,
                                     digest=dg))
                 idx += 1

@@ -16,14 +16,13 @@ kernel) share the selection logic — and therefore produce identical chunks.
 
 from __future__ import annotations
 
-import hashlib
 from typing import Callable, Iterable, Iterator
 
 import numpy as np
 
 from dfs_tpu.config import CDCParams, GEAR_HALO
 from dfs_tpu.meta.manifest import ChunkRef, Manifest
-from dfs_tpu.utils.hashing import sha256_many_hex
+from dfs_tpu.utils.hashing import sha256_many_hex, sha256_new
 
 # bitmap_fn(tile_u8, prev_g_u32[31]) -> (bitmap_bool[N], new_prev_g_u32[31])
 BitmapFn = Callable[[np.ndarray, np.ndarray], tuple[np.ndarray, np.ndarray]]
@@ -122,7 +121,7 @@ def manifest_from_stream(blocks: Iterable[bytes], params: CDCParams,
     finalized batch (CPU native by default; the TPU fragmenter passes its
     device batch hasher)."""
     chunker = StreamChunker(params, bitmap_fn)
-    whole = hashlib.sha256()
+    whole = sha256_new()
     refs: list[ChunkRef] = []
     pending: list[tuple[int, bytes]] = []
     size = 0

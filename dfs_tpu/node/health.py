@@ -14,8 +14,10 @@ from __future__ import annotations
 import asyncio
 import time
 
-from dfs_tpu.comm.rpc import InternalClient, RpcUnreachable
+from dfs_tpu.comm.rpc import (InternalClient, RpcError, RpcUnreachable)
 from dfs_tpu.config import ClusterConfig
+from dfs_tpu.utils.aio import create_logged_task
+from dfs_tpu.utils.logging import get_logger
 
 
 class HealthMonitor:
@@ -26,6 +28,7 @@ class HealthMonitor:
         self.self_id = self_id
         self.client = client
         self.probe_interval_s = probe_interval_s
+        self.log = get_logger("health", self_id)
         # optimistic start: everyone alive (matches reference behavior of
         # always trying peers); flips on first failure
         self._alive: dict[int, bool] = {
@@ -57,6 +60,15 @@ class HealthMonitor:
                 self.mark_alive(peer.node_id)
             except RpcUnreachable:
                 self.mark_dead(peer.node_id)
+            except RpcError as e:
+                # an application-level error came from a peer that
+                # ANSWERED: liveness evidence, not death — and it must
+                # not escape, or the whole probe loop dies with it (the
+                # pre-round-8 bug: one RpcRemoteError killed probing
+                # for the life of the node, silently)
+                self.mark_alive(peer.node_id)
+                self.log.warning("health probe of node %d answered an "
+                                 "error: %s", peer.node_id, e)
 
         await asyncio.gather(*(probe(p) for p in self.cluster.peers
                                if p.node_id != self.self_id))
@@ -67,7 +79,9 @@ class HealthMonitor:
                 await asyncio.sleep(self.probe_interval_s)
                 await self.probe_once()
 
-        self._task = asyncio.create_task(loop())
+        # retained reference + logged death: an unexpected exception in
+        # the probe loop must be visible, not vanish with a GC'd task
+        self._task = create_logged_task(loop(), self.log, "health-probe")
 
     def stop(self) -> None:
         if self._task is not None:

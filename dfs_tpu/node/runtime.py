@@ -46,7 +46,7 @@ from dfs_tpu.serve import BatchPrefetcher, ServingTier
 from dfs_tpu.store.aio import AsyncChunkStore
 from dfs_tpu.store.cas import NodeStore
 from dfs_tpu.utils.hashing import (is_hex_digest, sha256_hex,
-                                   sha256_many_hex)
+                                   sha256_many_hex, sha256_new)
 from dfs_tpu.utils.aio import gather_abort_siblings
 from dfs_tpu.utils.logging import Counters, Stopwatches, get_logger
 from dfs_tpu.utils.trace import LatencyRecorder, span
@@ -531,13 +531,12 @@ class StorageNodeServer:
         age out via GC. Per-batch stats are kept separately and merged
         in batch order, so the windowed schedule reports byte-identical
         stats to the serial one."""
-        import hashlib
         import queue as _queue
 
         loop = asyncio.get_running_loop()
         inq: _queue.Queue = _queue.Queue(maxsize=4)
         outq: asyncio.Queue = asyncio.Queue()
-        hasher = hashlib.sha256()
+        hasher = sha256_new()
         frag_dead = threading.Event()
         aborted = threading.Event()
         # byte credits: the fragmenter thread blocks once this many
@@ -822,11 +821,9 @@ class StorageNodeServer:
         # assemble incrementally (batches) to verify the whole-stream
         # hash AND place everything; bytes come from `provided`, the
         # local CAS, or replicas
-        import hashlib
-
         stats = self._new_upload_stats()
         stats["bytes"] = sum(len(b) for b in provided.values())
-        hasher = hashlib.sha256()
+        hasher = sha256_new()
         seen: set[str] = set()
         batch: list = []
         bsize = 0
@@ -1763,8 +1760,6 @@ class StorageNodeServer:
         a corrupted assembly is truncated before its last byte, never
         silently completed. The first batch is fetched eagerly so
         unrecoverable-chunk failures surface before any byte is sent."""
-        import hashlib
-
         manifest = await self._resolve_manifest(file_id)
         refs = list(manifest.chunks)
         batches: list[list] = []
@@ -1799,7 +1794,7 @@ class StorageNodeServer:
                     batches, lambda b: self._fetch_verified(manifest, b),
                     self.serve.readahead_batches, start=1)
                 pre.prime()   # batches 1..K fetch while batch 0 drains
-            hasher = hashlib.sha256()
+            hasher = sha256_new()
             held: bytes | None = None
             total = 0
             try:
@@ -2079,28 +2074,37 @@ class StorageNodeServer:
         # the local replica count permanently short. Batched via the same
         # grouped-fetch path downloads use (per-chunk RPCs measured ~7x
         # slower on the reconstruct bench).
+        async def restore_local(got: dict[str, bytes]) -> int:
+            # restored copies land through the async CAS tier: one
+            # bounded-pool job for the whole batch, OFF the event loop —
+            # inline puts here were the last chunk-file writes still
+            # running on the loop (dfslint DFS001), and a post-outage
+            # repair can restore most of a corpus in one pass
+            items = list(got.items())
+            stored = await self.cas.put_many(items, verify=False)
+            nstored = nbytes = 0
+            for (d, b), newly in zip(items, stored):
+                if newly:
+                    nstored += 1
+                    nbytes += len(b)
+                self.under_replicated.discard(d)
+            if nstored:
+                self.counters.inc("chunks_stored", nstored)
+                self.counters.inc("bytes_stored", nbytes)
+            return len(items)
+
         if own_missing:
             refs = [ChunkRef(index=0, offset=0, length=ln, digest=d)
                     for d, ln in own_missing.items()]
             got = await self._gather_chunks(None, chunks=refs,
                                             strict=False)
-            for d, b in got.items():
-                if self.store.chunks.put(d, b, verify=False):
-                    self.counters.inc("chunks_stored")
-                    self.counters.inc("bytes_stored", len(b))
-                repaired += 1
-                self.under_replicated.discard(d)
+            repaired += await restore_local(got)
         # EC shards this node should hold: gather WITH the manifest so
         # the parity-decode fallback can rebuild bytes that survive
         # nowhere (a replicated chunk in that state is simply gone)
         for m, refs in own_missing_ec:
             got = await self._gather_chunks(m, chunks=refs, strict=False)
-            for d, b in got.items():
-                if self.store.chunks.put(d, b, verify=False):
-                    self.counters.inc("chunks_stored")
-                    self.counters.inc("bytes_stored", len(b))
-                repaired += 1
-                self.under_replicated.discard(d)
+            repaired += await restore_local(got)
         verified: set[str] = set()
         for node_id, wanted in need.items():
             peer = self.cfg.cluster.peer(node_id)
@@ -2110,9 +2114,13 @@ class StorageNodeServer:
                     peer, {"op": "has_chunks", "digests": digests})
                 have = set(resp.get("have", []))
                 verified |= have
+                to_push = sorted(set(digests) - have)
+                # local reads ride the bounded CAS pool (one job for the
+                # batch, off the loop) like every other chunk-file touch
+                local = dict(await self.cas.get_many(to_push))
                 payload = []
-                for d in sorted(set(digests) - have):
-                    b = self.store.chunks.get(d)
+                for d in to_push:
+                    b = local.get(d)
                     if b is None:
                         if d in ec_digests:
                             # EC shards are stripe-placed, not on the

@@ -5,6 +5,32 @@ from __future__ import annotations
 import asyncio
 
 
+def create_logged_task(coro, log, what: str) -> asyncio.Task:
+    """``asyncio.create_task`` + an exception-logging done-callback.
+
+    The loop holds only WEAK task references, and a task nobody awaits
+    reports its exception (at best) at interpreter exit, attributed to
+    nothing — so a long-lived background loop (health probes, periodic
+    repair/scrub) that dies unexpectedly goes dark in silence. This
+    helper is the dfslint-DFS002-clean way to spawn one: the caller
+    still must RETAIN the returned task (the done-callback does not keep
+    it alive), but an unexpected death is logged the moment it happens.
+    Cancellation is not logged — it is how these loops are stopped.
+    """
+    task = asyncio.create_task(coro)
+
+    def _done(t: asyncio.Task) -> None:
+        if t.cancelled():
+            return
+        exc = t.exception()   # marks it retrieved either way
+        if exc is not None:
+            log.error("background task %r died unexpectedly: %s: %s",
+                      what, type(exc).__name__, exc)
+
+    task.add_done_callback(_done)
+    return task
+
+
 async def gather_abort_siblings(*coros):
     """gather() that CANCELS the surviving coroutines when one raises.
 

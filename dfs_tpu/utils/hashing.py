@@ -23,6 +23,16 @@ def sha256_hex(data: bytes | bytearray | memoryview | np.ndarray) -> str:
     return hashlib.sha256(data).hexdigest()
 
 
+def sha256_new() -> "hashlib._Hash":
+    """Fresh incremental SHA-256 hasher (update()/hexdigest()) for
+    whole-stream ids hashed block by block. This module is the one place
+    outside ``dfs_tpu/ops`` allowed to touch hashlib directly (dfslint
+    DFS004): every digest in the system routes through here so the
+    content-address namespace cannot be split by a second, differently-
+    configured hash implementation."""
+    return hashlib.sha256()
+
+
 def sha256_many_hex(chunks: list[bytes]) -> list[str]:
     """Digest a batch of byte strings via hashlib. Measured: OpenSSL's
     SHA-NI assembly under hashlib runs 1.0 GiB/s vs 0.19 for the portable

@@ -1,0 +1,3 @@
+"""Repo tooling package — makes ``python -m scripts.dfslint`` runnable
+from the repo root. The standalone ``scripts/check_artifacts.py`` is also
+importable directly (tests add this directory to sys.path)."""

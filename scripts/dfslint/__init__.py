@@ -1,0 +1,45 @@
+"""dfslint — project-specific AST concurrency & invariant analyzer.
+
+PRs 2-3 grew the node into a genuinely concurrent system: an asyncio
+event loop fronting bounded thread pools (store/aio.py), fire-and-forget
+tasks (serve/prefetch.py, node/health.py), windowed placement with
+completion sentinels (node/runtime.py), and ``threading.Lock``s shared
+across both worlds. The bug classes that mix produces — a sync syscall
+eating the event loop, a dropped task swallowing its exception, an
+``await`` under a thread lock, a digest computed outside the one
+verified implementation, a CLI flag silently losing its config field —
+are all *lexically visible*, so this package makes them machine-checkable
+on every tier-1 run (the same way scripts/check_artifacts.py made
+benchmark-citation hygiene machine-checkable).
+
+Pure stdlib ``ast`` — no new dependencies. See docs/lint.md for the rule
+catalogue, suppression syntax (``# dfslint: ignore[DFS001]``) and the
+committed baseline (scripts/dfslint/baseline.json).
+
+Usage::
+
+    python -m scripts.dfslint dfs_tpu scripts   # exit 0 clean / 1 findings
+    python -m scripts.dfslint --json            # machine-readable output
+    python -m scripts.dfslint --update-baseline # accept current findings
+"""
+
+from __future__ import annotations
+
+from scripts.dfslint.core import (Finding, Project, SourceFile,
+                                  collect_sources, load_baseline,
+                                  save_baseline)
+from scripts.dfslint.rules import ALL_RULES, run_rules
+
+__all__ = ["ALL_RULES", "Finding", "Project", "SourceFile", "analyze",
+           "collect_sources", "load_baseline", "run_rules",
+           "save_baseline"]
+
+
+def analyze(roots, repo_root, baseline: set[str] | frozenset[str] = frozenset()
+            ) -> list[Finding]:
+    """Walk ``roots``, run every rule, drop suppressed + baselined
+    findings. The one entry point the CLI and the tier-1 test share."""
+    project = Project(collect_sources(roots, repo_root))
+    out = [f for f in run_rules(project) if f.key not in baseline]
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out

@@ -1,0 +1,91 @@
+"""CLI entry: ``python -m scripts.dfslint [paths...]`` from the repo root.
+
+Exit-code contract (stable for CI):
+  0 — clean (no findings beyond the baseline)
+  1 — findings
+  2 — usage error (unknown flag, nonexistent path, malformed baseline)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from scripts.dfslint import analyze, load_baseline, save_baseline
+from scripts.dfslint.core import DEFAULT_BASELINE
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+# tier-1 scope: the package, the tooling, and the bench drivers
+DEFAULT_ROOTS = ("dfs_tpu", "scripts", "bench*.py")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m scripts.dfslint",
+        description="AST concurrency & invariant analyzer for the async "
+                    "node runtime (rules DFS001-DFS005, docs/lint.md)")
+    ap.add_argument("paths", nargs="*", default=list(DEFAULT_ROOTS),
+                    help="files/dirs/globs relative to the repo root "
+                         f"(default: {' '.join(DEFAULT_ROOTS)})")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help=f"baseline file (default {DEFAULT_BASELINE})")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="accept every current finding into the baseline "
+                         "and exit 0")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        # argparse exits 2 on usage error, 0 on --help: preserve both
+        return int(e.code or 0)
+
+    try:
+        baseline = set() if args.update_baseline \
+            else load_baseline(args.baseline)
+        findings = analyze(args.paths or list(DEFAULT_ROOTS), REPO_ROOT,
+                           baseline=baseline)
+    except FileNotFoundError as e:
+        print(f"dfslint: no such path: {e}", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(f"dfslint: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        keys = {f.key for f in findings}
+        if args.paths and args.paths != list(DEFAULT_ROOTS):
+            # narrowed scope: keep accepted keys the scan did not cover
+            # — rewriting from a partial run would silently un-accept
+            # every finding outside the given paths. A default-scope
+            # update rewrites wholesale (it saw everything), which is
+            # also how stale accepted keys get pruned.
+            try:
+                keys |= load_baseline(args.baseline)
+            except ValueError as e:
+                print(f"dfslint: {e}", file=sys.stderr)
+                return 2
+        path = save_baseline(keys, args.baseline)
+        print(f"dfslint: baseline updated ({len(keys)} accepted "
+              f"key(s)) -> {path}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in findings],
+            "count": len(findings),
+        }, indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"dfslint: {len(findings)} finding(s) — see "
+                  "docs/lint.md for the rule catalogue and suppression "
+                  "syntax", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

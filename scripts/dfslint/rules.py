@@ -1,0 +1,501 @@
+"""The five dfslint passes. Each is a pure function over the parsed
+``Project``; ``run_rules`` applies them all and filters inline
+suppressions. Rules are *lexical* by design — no type inference, no
+import following — so every check here is cheap, deterministic, and
+explainable in one sentence. What lexical analysis cannot see (e.g. a
+closure smuggled to a thread through a callback parameter) is documented
+per rule in docs/lint.md rather than half-guessed.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from scripts.dfslint.core import (Finding, Project, SourceFile, dotted,
+                                  scope_nodes)
+
+# ------------------------------------------------------------------ #
+# DFS001 — blocking call in async def
+# ------------------------------------------------------------------ #
+
+# module-qualified calls that park the event loop for a syscall/IO pass
+_BLOCKING_PREFIXES = ("socket.", "subprocess.")
+_BLOCKING_EXACT = frozenset({
+    "time.sleep", "open",
+    # urllib's opener is sync network I/O however it's spelled
+    "urllib.request.urlopen",
+})
+# Path-object file I/O methods (distinctive enough to match by name)
+_BLOCKING_METHODS = frozenset({"read_bytes", "write_bytes", "read_text",
+                               "write_text"})
+# direct sync ChunkStore data-plane ops; the async runtime must route
+# these through AsyncChunkStore (store/aio.py) or asyncio.to_thread —
+# inline they measured multi-second event-loop stalls under writeback
+# pressure (store/aio.py module docstring)
+_CHUNKSTORE_OPS = frozenset({"put", "get"})
+
+
+def check_blocking_in_async(project: Project) -> Iterator[Finding]:
+    for src in project.files:
+        if src.tree is None:
+            continue
+        for fn in ast.walk(src.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in scope_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func)
+                what = fix = None
+                if name in _BLOCKING_EXACT \
+                        or (name and name.startswith(_BLOCKING_PREFIXES)):
+                    what = f"blocking call {name}()"
+                    fix = "run it via asyncio.to_thread / an executor"
+                elif isinstance(node.func, ast.Attribute):
+                    attr = node.func.attr
+                    base = dotted(node.func.value)
+                    if attr in _BLOCKING_METHODS:
+                        what = f"sync file I/O .{attr}()"
+                        fix = "run it via asyncio.to_thread / an executor"
+                    elif (attr in _CHUNKSTORE_OPS and base
+                          and base.split(".")[-1] == "chunks"):
+                        what = f"direct ChunkStore.{attr}()"
+                        fix = ("route through AsyncChunkStore (self.cas)"
+                               " or asyncio.to_thread")
+                if what is None:
+                    continue
+                yield Finding(
+                    "DFS001", "error", src.rel, node.lineno,
+                    node.col_offset,
+                    f"{what} inside `async def {fn.name}` occupies the "
+                    f"event loop for the call's full duration — {fix}",
+                    f"{src.qualname(node)}:{name or node.func.attr}")
+
+
+# ------------------------------------------------------------------ #
+# DFS002 — dropped task
+# ------------------------------------------------------------------ #
+
+_SPAWN_NAMES = frozenset({"create_task", "ensure_future"})
+
+
+def _is_spawn(node: ast.Call) -> bool:
+    name = dotted(node.func)
+    if name in ("asyncio.create_task", "asyncio.ensure_future"):
+        return True
+    # loop.create_task(...) / anything.ensure_future(...)
+    return (isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SPAWN_NAMES)
+
+
+def check_dropped_task(project: Project) -> Iterator[Finding]:
+    """A bare ``asyncio.create_task(...)`` statement keeps no reference:
+    the event loop holds only weak refs, so the task can be GC'd and
+    silently cancelled mid-await — and if it fails, the exception is
+    logged (at best) at interpreter exit, attributed to nothing. The
+    result must be stored, awaited, or given a done-callback."""
+    for src in project.files:
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call) and _is_spawn(node)):
+                continue
+            parent = src.parents.get(node)
+            if not isinstance(parent, ast.Expr):
+                continue  # assigned / awaited / passed along / chained
+            yield Finding(
+                "DFS002", "error", src.rel, node.lineno, node.col_offset,
+                "task result discarded: store it, await it, or attach an "
+                "exception-logging done-callback — a dropped task can be "
+                "GC-cancelled and its exception vanishes",
+                f"{src.qualname(node)}:create_task")
+
+
+# ------------------------------------------------------------------ #
+# DFS003 — lock discipline across the sync/async boundary
+# ------------------------------------------------------------------ #
+
+_LOCKISH = re.compile(r"(lock|mutex|cond|(^|_)cv$)", re.IGNORECASE)
+# asyncio loop-affine calls that are not thread-safe; a function handed
+# to an executor must reach the loop via call_soon_threadsafe /
+# run_coroutine_threadsafe instead (note: *referencing* put_nowait as a
+# call_soon_threadsafe argument is fine and not a Call node)
+_LOOP_AFFINE_ATTRS = frozenset({"put_nowait", "set_result",
+                                "set_exception", "call_soon"})
+_LOOP_AFFINE_CALLS = frozenset({
+    "asyncio.create_task", "asyncio.ensure_future",
+    "asyncio.get_running_loop", "asyncio.get_event_loop",
+    "asyncio.sleep",
+})
+
+
+def _lockish(expr: ast.AST) -> str | None:
+    name = dotted(expr)
+    if name is None and isinstance(expr, ast.Call):
+        name = dotted(expr.func)   # with threading.Lock(): ...
+    if name and _LOCKISH.search(name.split(".")[-1]):
+        return name
+    return None
+
+
+def check_lock_discipline(project: Project) -> Iterator[Finding]:
+    for src in project.files:
+        if src.tree is None:
+            continue
+        # (a) `await` inside a *sync* `with <lock>` block in an async
+        # def. asyncio locks require `async with` (ast.AsyncWith), so a
+        # sync with on a lock-ish name + await inside means a
+        # threading.Lock held across a suspension point: every other
+        # task of the loop that touches that lock then blocks the whole
+        # loop until this coroutine is resumed — the classic
+        # loop-wedging deadlock shape.
+        for fn in ast.walk(src.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in scope_nodes(fn):
+                if not isinstance(node, ast.With):
+                    continue
+                held = next((n for it in node.items
+                             if (n := _lockish(it.context_expr))), None)
+                if held is None:
+                    continue
+                for aw in (n for n in scope_nodes(node)
+                           if isinstance(n, ast.Await)):
+                    yield Finding(
+                        "DFS003", "error", src.rel, aw.lineno,
+                        aw.col_offset,
+                        f"await while holding thread lock `{held}`: the "
+                        "lock stays held across the suspension, wedging "
+                        "every loop task that contends for it (use an "
+                        "asyncio.Lock with `async with`, or do not "
+                        "await under the lock)",
+                        f"{src.qualname(aw)}:await-under-{held}")
+        # (b) sync functions dispatched to executor threads must not
+        # touch loop-affine asyncio primitives directly
+        dispatched = _executor_dispatched(src)
+        for fn in dispatched:
+            for node in scope_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func)
+                bad = None
+                if name in _LOOP_AFFINE_CALLS:
+                    bad = f"{name}()"
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in _LOOP_AFFINE_ATTRS):
+                    bad = f".{node.func.attr}()"
+                if bad is None:
+                    continue
+                yield Finding(
+                    "DFS003", "error", src.rel, node.lineno,
+                    node.col_offset,
+                    f"`{fn.name}` runs on an executor thread but calls "
+                    f"loop-affine {bad} directly — asyncio primitives "
+                    "are not thread-safe; marshal through "
+                    "loop.call_soon_threadsafe / "
+                    "asyncio.run_coroutine_threadsafe",
+                    f"{src.qualname(node)}:{fn.name}:{bad}")
+
+
+def _executor_dispatched(src: SourceFile) -> list[ast.FunctionDef]:
+    """Sync FunctionDefs referenced by name as an executor target:
+    asyncio.to_thread(f, ...), loop.run_in_executor(pool, f, ...),
+    pool.submit(f, ...), threading.Thread(target=f)."""
+    names: set[str] = set()
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        target: ast.AST | None = None
+        if name == "asyncio.to_thread" and node.args:
+            target = node.args[0]
+        elif isinstance(node.func, ast.Attribute):
+            if node.func.attr == "run_in_executor" and len(node.args) >= 2:
+                target = node.args[1]
+            elif node.func.attr == "submit" and node.args:
+                target = node.args[0]
+            elif node.func.attr == "Thread":
+                target = next((kw.value for kw in node.keywords
+                               if kw.arg == "target"), None)
+        if name == "threading.Thread" or (name == "Thread"):
+            target = next((kw.value for kw in node.keywords
+                           if kw.arg == "target"), None) or target
+        if isinstance(target, ast.Name):
+            names.add(target.id)
+    return [n for n in ast.walk(src.tree)
+            if isinstance(n, ast.FunctionDef) and n.name in names]
+
+
+# ------------------------------------------------------------------ #
+# DFS004 — digest boundary
+# ------------------------------------------------------------------ #
+
+# the only trees allowed to touch hashlib directly: the verified host
+# implementation and the device kernels it is checked against
+_DIGEST_ALLOWED = ("dfs_tpu/utils/hashing.py", "dfs_tpu/ops/")
+_HASHLIB_CALLS = frozenset({"hashlib.sha256", "hashlib.sha1",
+                            "hashlib.md5", "hashlib.new"})
+
+
+def check_digest_boundary(project: Project) -> Iterator[Finding]:
+    """Every digest in the system is a content address — a single
+    differently-computed digest (different algorithm, stale import, a
+    future `usedforsecurity` flag divergence) silently splits the CAS
+    namespace. So raw hashlib stays behind dfs_tpu/utils/hashing.py
+    (sha256_hex / sha256_many_hex / sha256_new) and the ops/ kernels
+    that are bit-exactness-tested against it."""
+    for src in project.files:
+        if src.tree is None:
+            continue
+        if (src.rel.endswith(_DIGEST_ALLOWED[0])
+                or f"/{_DIGEST_ALLOWED[0]}" in src.rel
+                or _DIGEST_ALLOWED[1] in src.rel):
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name not in _HASHLIB_CALLS:
+                continue
+            yield Finding(
+                "DFS004", "error", src.rel, node.lineno, node.col_offset,
+                f"raw {name}() outside dfs_tpu/utils/hashing.py + "
+                "dfs_tpu/ops/ — digests must go through the one "
+                "verified implementation (sha256_hex / sha256_many_hex "
+                "/ sha256_new)",
+                f"{src.qualname(node)}:{name}")
+
+
+# ------------------------------------------------------------------ #
+# DFS005 — config drift (CLI flags <-> config fields <-> /metrics keys)
+# ------------------------------------------------------------------ #
+
+# dataclasses in dfs_tpu/config.py whose every field must be settable
+# from the `serve` CLI (a field without a flag silently pins a
+# deployment to the default — the drift this rule exists to catch)
+_CLI_CLASSES = ("NodeConfig", "ServeConfig", "IngestConfig")
+# config field -> /metrics key that surfaces it, per stats function.
+# "cas" carries cas_io_threads as its nested workers count
+# (store/aio.py stats()).
+_INGEST_METRIC_KEYS = {"window": "window", "flush_bytes": "flushBytes",
+                       "credit_bytes": "creditBytes",
+                       "slice_inflight": "sliceInflight",
+                       "cas_io_threads": "cas"}
+# the four admission knobs surface inside the "admission" section;
+# cache_bytes inside "cache" (serve/__init__.py ServingTier.stats())
+_SERVE_METRIC_KEYS = {"cache_bytes": "cache",
+                      "readahead_batches": "readaheadBatches",
+                      "download_slots": "admission",
+                      "upload_slots": "admission",
+                      "internal_slots": "admission",
+                      "queue_depth": "admission",
+                      "retry_after_s": "admission"}
+
+
+def _dataclass_fields(src: SourceFile) -> dict[str, dict[str, int]]:
+    """class name -> {field name -> lineno} for the config dataclasses
+    (AnnAssign fields only; ALL_CAPS constants and init=False fields are
+    not CLI surface)."""
+    out: dict[str, dict[str, int]] = {}
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ClassDef) \
+                or node.name not in _CLI_CLASSES:
+            continue
+        fields: dict[str, int] = {}
+        for stmt in node.body:
+            if not (isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)):
+                continue
+            fname = stmt.target.id
+            if fname.isupper() or fname.startswith("_"):
+                continue
+            if isinstance(stmt.value, ast.Call) \
+                    and dotted(stmt.value.func) in ("dataclasses.field",
+                                                    "field"):
+                init_kw = next((kw.value for kw in stmt.value.keywords
+                                if kw.arg == "init"), None)
+                if isinstance(init_kw, ast.Constant) \
+                        and init_kw.value is False:
+                    continue   # init=False: not constructor surface
+            fields[fname] = stmt.lineno
+        out[node.name] = fields
+    return out
+
+
+def _add_argument_dests(src: SourceFile) -> dict[str, int]:
+    """argparse dest -> lineno for every add_argument call."""
+    out: dict[str, int] = {}
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument" and node.args):
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue
+        dest = next((kw.value.value for kw in node.keywords
+                     if kw.arg == "dest"
+                     and isinstance(kw.value, ast.Constant)), None)
+        if dest is None:
+            dest = first.value.lstrip("-").replace("-", "_")
+        out[str(dest)] = node.lineno
+    return out
+
+
+def _args_reads(src: SourceFile) -> set[str]:
+    """Every attribute read off an ``args`` namespace — plain
+    ``args.x`` plus ``getattr(args, "x", ...)``."""
+    reads: set[str] = set()
+    for node in ast.walk(src.tree):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "args"):
+            reads.add(node.attr)
+        elif (isinstance(node, ast.Call)
+              and dotted(node.func) == "getattr" and len(node.args) >= 2
+              and isinstance(node.args[0], ast.Name)
+              and node.args[0].id == "args"
+              and isinstance(node.args[1], ast.Constant)):
+            reads.add(str(node.args[1].value))
+    return reads
+
+
+def _stats_dict_keys(src: SourceFile, func_name: str) -> set[str] | None:
+    """String keys assembled by ``func_name``: dict-literal keys in any
+    return/assignment plus ``out["key"] = ...`` subscript stores.
+    None when the function is absent (sub-check skipped)."""
+    fn = next((n for n in ast.walk(src.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+               and n.name == func_name), None)
+    if fn is None:
+        return None
+    keys: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Dict):
+            keys.update(k.value for k in node.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str))
+        elif (isinstance(node, ast.Assign)
+              and any(isinstance(t, ast.Subscript) for t in node.targets)):
+            for t in node.targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.slice, ast.Constant)
+                        and isinstance(t.slice.value, str)):
+                    keys.add(t.slice.value)
+    return keys
+
+
+def check_config_drift(project: Project) -> Iterator[Finding]:
+    cfg = project.find("dfs_tpu/config.py")
+    cli = project.find("dfs_tpu/cli/main.py")
+    runtime = project.find("dfs_tpu/node/runtime.py")
+    serve_pkg = project.find("dfs_tpu/serve/__init__.py")
+    classes = _dataclass_fields(cfg) if cfg and cfg.tree else {}
+
+    # (1) every config field is wired through the serve CLI's
+    # constructor calls in cmd_serve
+    if cfg and cli and cli.tree and classes:
+        cmd = next((n for n in ast.walk(cli.tree)
+                    if isinstance(n, ast.FunctionDef)
+                    and n.name == "cmd_serve"), None)
+        if cmd is not None:
+            calls: dict[str, ast.Call] = {}
+            for node in ast.walk(cmd):
+                if isinstance(node, ast.Call):
+                    name = dotted(node.func)
+                    if name and name.split(".")[-1] in classes:
+                        calls[name.split(".")[-1]] = node
+            for cls, fields in classes.items():
+                call = calls.get(cls)
+                if call is None:
+                    continue   # class not constructed by the CLI at all
+                passed = {kw.arg for kw in call.keywords if kw.arg}
+                for fname, _lineno in sorted(fields.items()):
+                    if fname in passed:
+                        continue
+                    yield Finding(
+                        "DFS005", "error", cli.rel, call.lineno,
+                        call.col_offset,
+                        f"{cls}.{fname} is not passed by cmd_serve's "
+                        f"{cls}(...) — the flag surface silently lost "
+                        "this config field (deployments are pinned to "
+                        "its default)",
+                        f"cmd_serve:{cls}.{fname}")
+
+    # (2) every declared flag is read somewhere (dead-flag detection:
+    # an add_argument whose dest is never consumed parses and then
+    # silently does nothing)
+    if cli and cli.tree:
+        reads = _args_reads(cli)
+        for dest, lineno in sorted(_add_argument_dests(cli).items()):
+            if dest in reads or dest in ("help",):
+                continue
+            yield Finding(
+                "DFS005", "error", cli.rel, lineno, 0,
+                f"flag dest `{dest}` is declared but `args.{dest}` is "
+                "never read — the flag parses and silently does nothing",
+                f"build_parser:{dest}")
+
+    # (3) every config knob has its /metrics counterpart key, so a new
+    # knob cannot ship observably-invisible
+    for src, func, cls, table in (
+            (runtime, "ingest_stats", "IngestConfig", _INGEST_METRIC_KEYS),
+            (serve_pkg, "stats", "ServeConfig", _SERVE_METRIC_KEYS)):
+        if src is None or src.tree is None or cls not in classes:
+            continue
+        keys = _stats_dict_keys(src, func)
+        if keys is None:
+            continue
+        for fname in sorted(classes[cls]):
+            want = table.get(fname)
+            if want is None:
+                yield Finding(
+                    "DFS005", "error", cfg.rel,
+                    classes[cls][fname], 0,
+                    f"{cls}.{fname} has no /metrics mapping — add it to "
+                    f"dfslint's {cls} metrics table AND surface it in "
+                    f"{func}()",
+                    f"{cls}:{fname}:unmapped")
+            elif want not in keys:
+                yield Finding(
+                    "DFS005", "error", src.rel, 0, 0,
+                    f"{func}() does not surface `{want}` — "
+                    f"{cls}.{fname} lost its /metrics counterpart",
+                    f"{func}:{fname}")
+
+
+# ------------------------------------------------------------------ #
+# registry
+# ------------------------------------------------------------------ #
+
+ALL_RULES = (
+    ("DFS001", "blocking call in async def", check_blocking_in_async),
+    ("DFS002", "dropped asyncio task", check_dropped_task),
+    ("DFS003", "lock discipline across sync/async", check_lock_discipline),
+    ("DFS004", "digest outside utils/hashing + ops", check_digest_boundary),
+    ("DFS005", "CLI/config//metrics drift", check_config_drift),
+)
+
+
+def run_rules(project: Project) -> list[Finding]:
+    """All passes over one parsed project, minus inline suppressions.
+    Unparseable files surface as DFS000 findings (a syntax error must
+    fail the gate, not silently shrink the scanned set)."""
+    out: list[Finding] = []
+    by_rel = {s.rel: s for s in project.files}
+    for src in project.files:
+        if src.parse_error is not None:
+            out.append(Finding(
+                "DFS000", "error", src.rel,
+                src.parse_error.lineno or 0, 0,
+                f"syntax error: {src.parse_error.msg}", "<parse>"))
+    for _rule_id, _desc, fn in ALL_RULES:
+        for f in fn(project):
+            src = by_rel.get(f.path)
+            if src is not None and src.is_suppressed(f.rule, f.line):
+                continue
+            out.append(f)
+    return out

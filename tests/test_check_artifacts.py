@@ -1,7 +1,13 @@
-"""Tier-1 enforcement of the artifact-citation lint: committed code
-citing a ``*_rNN.json`` that is not in the repo is the
-claim-without-artifact failure mode VERDICT dinged in rounds 3 and 5
-(the round-5 ``SLOW_r05`` phantom); this turns it into a test failure.
+"""Tier-1 lint slot: BOTH repo linters gate here.
+
+1. check_artifacts — committed code citing a ``*_rNN.json`` that is not
+   in the repo is the claim-without-artifact failure mode VERDICT dinged
+   in rounds 3 and 5 (the round-5 ``SLOW_r05`` phantom); this turns it
+   into a test failure.
+2. dfslint — the AST concurrency & invariant analyzer (docs/lint.md):
+   the tree must stay clean modulo the committed baseline. Rule-level
+   fixture coverage lives in tests/test_dfslint.py; this module is the
+   single place the suite ENFORCES both hygiene lints.
 
 Example artifact names in this file are assembled at runtime — a
 literal phantom citation in the lint's own test would (correctly) fail
@@ -12,8 +18,12 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO / "scripts"))
+sys.path.insert(0, str(REPO))
 
 import check_artifacts  # noqa: E402
+
+from scripts import dfslint  # noqa: E402
+from scripts.dfslint.__main__ import DEFAULT_ROOTS  # noqa: E402
 
 
 def test_no_dangling_artifact_citations():
@@ -21,6 +31,17 @@ def test_no_dangling_artifact_citations():
     assert problems == [], (
         "committed code cites benchmark artifacts that do not exist in "
         "the repo:\n  " + "\n  ".join(problems))
+
+
+def test_dfslint_gates_green():
+    """The analyzer half of the tier-1 lint slot: every DFS001-DFS005
+    finding on the real tree is either fixed, inline-suppressed with a
+    justification, or deliberately baselined."""
+    findings = dfslint.analyze(list(DEFAULT_ROOTS), REPO,
+                               baseline=dfslint.load_baseline())
+    assert findings == [], (
+        "dfslint violations (see docs/lint.md):\n  "
+        + "\n  ".join(f.render() for f in findings))
 
 
 def test_lint_catches_a_phantom(tmp_path):

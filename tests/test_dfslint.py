@@ -1,0 +1,463 @@
+"""dfslint: fixture-driven true-positive/true-negative coverage for every
+rule, the suppression/baseline machinery, the walker's non-source-tree
+skipping, the CLI exit-code contract — and the real tree staying clean
+modulo the committed baseline (the enforcement half, mirroring
+test_check_artifacts.py).
+
+Fixture snippets are deliberately tiny and self-contained: each
+seeded-violation snippet must trip EXACTLY its rule, and each clean
+snippet must trip nothing — that is what keeps the analyzer honest as
+rules evolve.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from scripts.dfslint import analyze, load_baseline  # noqa: E402
+from scripts.dfslint.core import DEFAULT_BASELINE  # noqa: E402
+from scripts.dfslint.__main__ import DEFAULT_ROOTS  # noqa: E402
+
+
+def lint(tmp_path: Path, files: dict[str, str],
+         baseline: set[str] = frozenset()) -> list:
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    return analyze(["."], tmp_path, baseline=baseline)
+
+
+def rules_of(findings) -> list[str]:
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------------------ #
+# DFS001 — blocking call in async def
+# ------------------------------------------------------------------ #
+
+def test_dfs001_true_positives(tmp_path):
+    found = lint(tmp_path, {"mod.py": (
+        "import time\n"
+        "async def a():\n"
+        "    time.sleep(1)\n"
+        "async def b():\n"
+        "    open('/tmp/x')\n"
+        "async def c(self):\n"
+        "    self.store.chunks.put('d', b'x')\n"
+        "async def d(self):\n"
+        "    return self.store.chunks.get('d')\n")})
+    assert rules_of(found) == ["DFS001"] * 4
+    assert all(f.path == "mod.py" for f in found)
+
+
+def test_dfs001_true_negatives(tmp_path):
+    # sync defs may block; to_thread-wrapped lambdas/closures are a
+    # different (thread) scope — exactly the runtime's store_all shape;
+    # the async CAS tier (self.cas) is the sanctioned route
+    found = lint(tmp_path, {"mod.py": (
+        "import asyncio, time\n"
+        "def sync_ok():\n"
+        "    time.sleep(1)\n"
+        "    open('/tmp/x')\n"
+        "async def wrapped(self):\n"
+        "    def store_all():\n"
+        "        return self.store.chunks.put('d', b'x')\n"
+        "    await asyncio.to_thread(store_all)\n"
+        "    await asyncio.to_thread(lambda: self.store.chunks.get('d'))\n"
+        "async def via_cas(self):\n"
+        "    await self.cas.put('d', b'x')\n"
+        "    return await self.cas.get('d')\n"
+        "async def dict_get_ok(header):\n"
+        "    return header.get('digest')\n")})
+    assert found == []
+
+
+# ------------------------------------------------------------------ #
+# DFS002 — dropped task
+# ------------------------------------------------------------------ #
+
+def test_dfs002_true_positive(tmp_path):
+    found = lint(tmp_path, {"mod.py": (
+        "import asyncio\n"
+        "async def spawn(work):\n"
+        "    asyncio.create_task(work())\n"
+        "async def spawn2(loop, work):\n"
+        "    loop.create_task(work())\n")})
+    assert rules_of(found) == ["DFS002", "DFS002"]
+
+
+def test_dfs002_true_negatives(tmp_path):
+    found = lint(tmp_path, {"mod.py": (
+        "import asyncio\n"
+        "async def kept(work, tasks):\n"
+        "    t = asyncio.create_task(work())\n"
+        "    tasks.append(asyncio.create_task(work()))\n"
+        "    asyncio.create_task(work()).add_done_callback(print)\n"
+        "    await asyncio.create_task(work())\n"
+        "    return t\n")})
+    assert found == []
+
+
+# ------------------------------------------------------------------ #
+# DFS003 — lock discipline
+# ------------------------------------------------------------------ #
+
+def test_dfs003_await_under_thread_lock(tmp_path):
+    found = lint(tmp_path, {"mod.py": (
+        "async def bad(self, fetch):\n"
+        "    with self._lock:\n"
+        "        await fetch()\n")})
+    assert rules_of(found) == ["DFS003"]
+    assert "await while holding thread lock" in found[0].message
+
+
+def test_dfs003_lock_true_negatives(tmp_path):
+    found = lint(tmp_path, {"mod.py": (
+        "async def ok_async_lock(self, fetch):\n"
+        "    async with self._alock:\n"   # asyncio.Lock idiom
+        "        await fetch()\n"
+        "async def ok_no_await(self):\n"
+        "    with self._lock:\n"
+        "        self.n += 1\n"
+        "async def ok_nested_def(self, pool):\n"
+        "    def job():\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n"
+        "    return job\n")})
+    assert found == []
+
+
+def test_dfs003_executor_dispatched_loop_affinity(tmp_path):
+    found = lint(tmp_path, {"mod.py": (
+        "import asyncio\n"
+        "async def run(outq):\n"
+        "    def worker():\n"
+        "        outq.put_nowait(1)\n"       # loop-affine from a thread
+        "    await asyncio.to_thread(worker)\n")})
+    assert rules_of(found) == ["DFS003"]
+    assert "executor thread" in found[0].message
+
+
+def test_dfs003_call_soon_threadsafe_is_clean(tmp_path):
+    # the runtime's on_chunk/run_fragmenter shape: the primitive is
+    # REFERENCED as a call_soon_threadsafe argument, never called there
+    found = lint(tmp_path, {"mod.py": (
+        "import asyncio\n"
+        "async def run(loop, outq):\n"
+        "    def worker():\n"
+        "        loop.call_soon_threadsafe(outq.put_nowait, 1)\n"
+        "    await asyncio.to_thread(worker)\n")})
+    assert found == []
+
+
+# ------------------------------------------------------------------ #
+# DFS004 — digest boundary
+# ------------------------------------------------------------------ #
+
+def test_dfs004_true_positive_and_allowed_trees(tmp_path):
+    files = {
+        "dfs_tpu/node/x.py": ("import hashlib\n"
+                              "def f(b):\n"
+                              "    return hashlib.sha256(b).hexdigest()\n"),
+        "dfs_tpu/ops/kernel.py": ("import hashlib\n"
+                                  "def g(b):\n"
+                                  "    return hashlib.sha256(b).digest()\n"),
+        "dfs_tpu/utils/hashing.py": ("import hashlib\n"
+                                     "def sha256_hex(b):\n"
+                                     "    return hashlib.sha256(b)"
+                                     ".hexdigest()\n"),
+    }
+    found = lint(tmp_path, files)
+    assert rules_of(found) == ["DFS004"]
+    assert found[0].path == "dfs_tpu/node/x.py"
+
+
+def test_dfs004_other_algorithms_flagged(tmp_path):
+    found = lint(tmp_path, {"dfs_tpu/node/y.py": (
+        "import hashlib\n"
+        "def f(b):\n"
+        "    return hashlib.md5(b).hexdigest()\n")})
+    assert rules_of(found) == ["DFS004"]
+
+
+# ------------------------------------------------------------------ #
+# DFS005 — config drift
+# ------------------------------------------------------------------ #
+
+_MINI_CONFIG = (
+    "import dataclasses\n"
+    "@dataclasses.dataclass(frozen=True)\n"
+    "class ServeConfig:\n"
+    "    cache_bytes: int = 0\n"
+    "    retry_after_s: float = 1.0\n")
+
+_MINI_CLI_OK = (
+    "from dfs_tpu.config import ServeConfig\n"
+    "def cmd_serve(args):\n"
+    "    return ServeConfig(cache_bytes=args.cache_bytes,\n"
+    "                       retry_after_s=args.retry_after)\n"
+    "def build_parser(sub):\n"
+    "    sub.add_argument('--cache-bytes', type=int, default=0)\n"
+    "    sub.add_argument('--retry-after', type=float, default=1.0)\n")
+
+
+def test_dfs005_missing_cli_field(tmp_path):
+    cli = (
+        "from dfs_tpu.config import ServeConfig\n"
+        "def cmd_serve(args):\n"
+        "    return ServeConfig(cache_bytes=args.cache_bytes)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--cache-bytes', type=int, default=0)\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": _MINI_CONFIG,
+                            "dfs_tpu/cli/main.py": cli})
+    assert rules_of(found) == ["DFS005"]
+    assert "ServeConfig.retry_after_s" in found[0].message
+
+
+def test_dfs005_init_false_skipped_but_explicit_init_true_checked(tmp_path):
+    """Only init=False fields are exempt from the CLI-wiring check
+    (code-review regression: any field() mentioning the init kwarg used
+    to escape, so `init=True` hid exactly the drift the rule exists
+    for)."""
+    cfg = (
+        "import dataclasses\n"
+        "@dataclasses.dataclass(frozen=True)\n"
+        "class ServeConfig:\n"
+        "    cache_bytes: int = 0\n"
+        "    derived: int = dataclasses.field(default=1, init=False)\n"
+        "    explicit: int = dataclasses.field(default=2, init=True)\n")
+    cli = (
+        "from dfs_tpu.config import ServeConfig\n"
+        "def cmd_serve(args):\n"
+        "    return ServeConfig(cache_bytes=args.cache_bytes)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--cache-bytes', type=int, default=0)\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/cli/main.py": cli})
+    assert rules_of(found) == ["DFS005"]
+    assert "ServeConfig.explicit" in found[0].message
+
+
+def test_dfs005_dead_flag(tmp_path):
+    cli = _MINI_CLI_OK + (
+        "def more(sub):\n"
+        "    sub.add_argument('--never-read', type=int, default=0)\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": _MINI_CONFIG,
+                            "dfs_tpu/cli/main.py": cli})
+    assert rules_of(found) == ["DFS005"]
+    assert "never_read" in found[0].message
+
+
+def test_dfs005_getattr_counts_as_read(tmp_path):
+    cli = _MINI_CLI_OK + (
+        "def more(sub):\n"
+        "    sub.add_argument('--via-getattr', type=int, default=0)\n"
+        "def uses(args):\n"
+        "    return getattr(args, 'via_getattr', 0)\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": _MINI_CONFIG,
+                            "dfs_tpu/cli/main.py": cli})
+    assert found == []
+
+
+def test_dfs005_metrics_counterpart(tmp_path):
+    cfg = (
+        "import dataclasses\n"
+        "@dataclasses.dataclass(frozen=True)\n"
+        "class IngestConfig:\n"
+        "    window: int = 2\n")
+    runtime_missing = (
+        "class S:\n"
+        "    def ingest_stats(self):\n"
+        "        return {'somethingElse': 1}\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/node/runtime.py": runtime_missing})
+    assert rules_of(found) == ["DFS005"]
+    assert "window" in found[0].message
+
+    runtime_ok = (
+        "class S:\n"
+        "    def ingest_stats(self):\n"
+        "        return {'window': 2}\n")
+    assert lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                           "dfs_tpu/node/runtime.py": runtime_ok}) == []
+
+
+def test_dfs005_unmapped_field_needs_table_entry(tmp_path):
+    cfg = (
+        "import dataclasses\n"
+        "@dataclasses.dataclass(frozen=True)\n"
+        "class IngestConfig:\n"
+        "    window: int = 2\n"
+        "    brand_new_knob: int = 0\n")
+    runtime = ("class S:\n"
+               "    def ingest_stats(self):\n"
+               "        return {'window': 2}\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/node/runtime.py": runtime})
+    assert rules_of(found) == ["DFS005"]
+    assert "no /metrics mapping" in found[0].message
+
+
+# ------------------------------------------------------------------ #
+# suppressions, baseline, walker, parse errors
+# ------------------------------------------------------------------ #
+
+def test_inline_suppression_same_line_and_comment_above(tmp_path):
+    found = lint(tmp_path, {"mod.py": (
+        "import time\n"
+        "async def a():\n"
+        "    time.sleep(1)  # dfslint: ignore[DFS001]\n"
+        "async def b():\n"
+        "    # justification lives here\n"
+        "    # dfslint: ignore[DFS001]\n"
+        "    time.sleep(1)\n"
+        "async def c():\n"
+        "    time.sleep(1)  # dfslint: ignore[DFS004]\n")})
+    # a and b are suppressed; c's suppression names the WRONG rule
+    assert rules_of(found) == ["DFS001"]
+    assert found[0].context.startswith("c:")
+
+
+def test_baseline_accepts_by_stable_key(tmp_path):
+    files = {"mod.py": ("import time\n"
+                        "async def a():\n"
+                        "    time.sleep(1)\n")}
+    found = lint(tmp_path, dict(files))
+    assert rules_of(found) == ["DFS001"]
+    assert found[0].key == f"DFS001:mod.py:{found[0].context}"
+    assert lint(tmp_path, {}, baseline={found[0].key}) == []
+
+
+def test_walker_skips_pycache_and_data_trees(tmp_path):
+    found = lint(tmp_path, {
+        "pkg/__pycache__/evil.py": ("import time\n"
+                                    "async def a():\n"
+                                    "    time.sleep(1)\n"),
+        "data/leftover.py": ("import time\n"
+                             "async def a():\n"
+                             "    time.sleep(1)\n"),
+        "pkg/ok.py": "x = 1\n"})
+    assert found == []
+
+
+def test_parse_error_is_a_finding_not_a_crash(tmp_path):
+    found = lint(tmp_path, {"mod.py": "def broken(:\n"})
+    assert rules_of(found) == ["DFS000"]
+
+
+# ------------------------------------------------------------------ #
+# CLI contract
+# ------------------------------------------------------------------ #
+
+def _cli(args, cwd=REPO):
+    return subprocess.run([sys.executable, "-m", "scripts.dfslint", *args],
+                          cwd=cwd, capture_output=True, text=True,
+                          timeout=120)
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nasync def a():\n    time.sleep(1)\n")
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+
+    r = _cli([str(ok)])
+    assert r.returncode == 0, r.stderr
+
+    r = _cli([str(bad)])
+    assert r.returncode == 1
+    assert "DFS001" in r.stdout
+
+    r = _cli([str(tmp_path / "does_not_exist")])
+    assert r.returncode == 2
+
+    r = _cli([str(bad), "--json"])
+    out = json.loads(r.stdout)
+    assert out["count"] == 1
+    assert out["findings"][0]["rule"] == "DFS001"
+    assert out["findings"][0]["key"].startswith("DFS001:")
+
+
+def test_malformed_baseline_is_usage_error(tmp_path):
+    """Exit-2 contract (code-review regression): a baseline that parses
+    as JSON but lacks the accepted-keys list must be a usage error, not
+    a traceback or a bogus findings exit."""
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    for bad_text in ("{}", '{"accepted": "nope"}', '{"accepted": [1]}'):
+        bl = tmp_path / "bl.json"
+        bl.write_text(bad_text)
+        r = _cli([str(ok), "--baseline", str(bl)])
+        assert r.returncode == 2, (bad_text, r.stdout, r.stderr)
+        assert "malformed baseline" in r.stderr
+
+
+def test_update_baseline_narrowed_scope_merges(tmp_path):
+    """--update-baseline over a subset of paths must KEEP accepted keys
+    for files outside the scan (code-review regression: a partial run
+    used to rewrite the baseline wholesale, silently un-accepting
+    everything it did not see)."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nasync def a():\n    time.sleep(1)\n")
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(
+        {"accepted": ["DFS004:elsewhere/mod.py:f:hashlib.sha256"]}))
+
+    r = _cli([str(bad), "--baseline", str(bl), "--update-baseline"])
+    assert r.returncode == 0, r.stderr
+    kept = json.loads(bl.read_text())["accepted"]
+    assert "DFS004:elsewhere/mod.py:f:hashlib.sha256" in kept
+    assert any(k.startswith("DFS001:") for k in kept)
+
+
+def test_cli_update_baseline_roundtrip(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nasync def a():\n    time.sleep(1)\n")
+    bl = tmp_path / "baseline.json"
+
+    r = _cli([str(bad), "--baseline", str(bl), "--update-baseline"])
+    assert r.returncode == 0, r.stderr
+    assert len(json.loads(bl.read_text())["accepted"]) == 1
+
+    # the accepted finding no longer gates...
+    assert _cli([str(bad), "--baseline", str(bl)]).returncode == 0
+    # ...but a NEW violation still does
+    bad.write_text(bad.read_text()
+                   + "async def b():\n    time.sleep(2)\n")
+    assert _cli([str(bad), "--baseline", str(bl)]).returncode == 1
+
+
+# ------------------------------------------------------------------ #
+# the real tree (enforcement): clean modulo the committed baseline
+# ------------------------------------------------------------------ #
+
+def test_real_tree_clean_modulo_baseline():
+    findings = analyze(list(DEFAULT_ROOTS), REPO,
+                       baseline=load_baseline(DEFAULT_BASELINE))
+    assert findings == [], (
+        "dfslint found new violations (fix them, suppress with a "
+        "justified `# dfslint: ignore[RULE]`, or baseline deliberately "
+        "- see docs/lint.md):\n  "
+        + "\n  ".join(f.render() for f in findings))
+
+
+def test_serve_cli_exposes_every_config_field():
+    """Drift regression for the DFS005 fixes: the flags added in this PR
+    must keep parsing and land in the right NodeConfig fields."""
+    from dfs_tpu.cli.main import build_parser
+
+    ns = build_parser().parse_args(
+        ["serve", "--node-id", "1", "--write-quorum", "1",
+         "--probe-interval", "0", "--rpc-retries", "2",
+         "--connect-timeout", "0.5", "--request-timeout", "3",
+         "--retry-after", "2.5", "--fixed-parts", "7"])
+    assert (ns.write_quorum, ns.probe_interval, ns.rpc_retries) == (1, 0, 2)
+    assert (ns.connect_timeout, ns.request_timeout) == (0.5, 3.0)
+    assert (ns.retry_after, ns.fixed_parts) == (2.5, 7)

@@ -5,6 +5,10 @@ import asyncio
 
 import numpy as np
 
+from dfs_tpu.comm.rpc import RpcRemoteError
+from dfs_tpu.config import ClusterConfig
+from dfs_tpu.node.health import HealthMonitor
+from dfs_tpu.utils.aio import create_logged_task
 from tests.test_node_cluster import make_cluster_cfg, start_nodes, stop_nodes
 
 
@@ -30,6 +34,67 @@ def test_health_feedback_and_probe_recovery(tmp_path, rng):
             assert nodes[1].health.is_alive(3) is True
         finally:
             await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_probe_survives_remote_error():
+    """Regression (dfslint PR satellite): a peer that ANSWERS a health
+    probe with an application-level error is alive — and before round 8
+    the error escaped probe(), killed the gather, and the probe LOOP
+    died with it: the task held in self._task failed silently and the
+    node never probed again. RpcRemoteError must neither mark the peer
+    dead nor propagate."""
+
+    class AnsweringButBroken:
+        async def health(self, peer):
+            raise RpcRemoteError(f"peer {peer.node_id} error: busted")
+
+    async def run():
+        cluster = ClusterConfig.localhost(3)
+        mon = HealthMonitor(cluster, self_id=1,
+                            client=AnsweringButBroken())
+        mon.mark_dead(2)
+        await mon.probe_once()   # must not raise
+        assert mon.is_alive(2) is True   # an answer is liveness
+        assert mon.is_alive(3) is True
+
+    asyncio.run(run())
+
+
+def test_create_logged_task_logs_unexpected_death():
+    """Regression (dfslint DFS002 satellite): background loops spawned
+    via create_logged_task surface an unexpected exception through the
+    logger the moment the task dies — instead of parking it on a task
+    nobody awaits. Cancellation stays silent (it is how loops stop)."""
+
+    class Spy:
+        def __init__(self):
+            self.errors = []
+
+        def error(self, msg, *args):
+            self.errors.append(msg % args)
+
+    async def run():
+        spy = Spy()
+
+        async def boom():
+            raise RuntimeError("probe exploded")
+
+        t = create_logged_task(boom(), spy, "probe-loop")
+        await asyncio.gather(t, return_exceptions=True)
+        await asyncio.sleep(0)   # let the done-callback run
+        assert any("probe-loop" in e and "probe exploded" in e
+                   for e in spy.errors), spy.errors
+
+        async def forever():
+            await asyncio.Event().wait()
+
+        t2 = create_logged_task(forever(), spy, "stoppable")
+        t2.cancel()
+        await asyncio.gather(t2, return_exceptions=True)
+        await asyncio.sleep(0)
+        assert not any("stoppable" in e for e in spy.errors)
 
     asyncio.run(run())
 

@@ -1,0 +1,209 @@
+"""Observability benchmark -> OBS_r09.json: stitched cross-node tracing
+evidence + the always-on tracing overhead bound.
+
+Two phases, in-process nodes, CPU CDC engine (tracing is backend- and
+transport-agnostic):
+
+1. stitched trace — a 3-node cluster, upload at node 1 and download at
+   node 3, both requests tagged with ONE client-minted trace id via the
+   ``X-Dfs-Trace`` header. ``GET /trace?traceId=…`` on node 1 must
+   return a single connected trace: spans from >= 2 nodes, client-facing
+   HTTP spans present, and >= 1 CROSS-NODE parent link (a span whose
+   parent span lives on a different node — the rpc.* -> peer.* edge the
+   wire ``trace`` field exists to create).
+2. tracing overhead — cached hot reads (SERVE_r06 phase-2b methodology:
+   ``download_range`` on a warm SIEVE cache, ``readers`` concurrent
+   whole-file reads x rounds), each read entered through a request span
+   exactly like the HTTP layer does. Arms: default ObsConfig (ring on)
+   vs ``trace_ring=0`` (tracing fully off), alternated over several
+   repeats, best-of each arm compared. Acceptance: tracing adds <= 2%.
+
+Usage: python bench_obs.py [file_bytes] [readers]
+Writes OBS_r09.json and prints it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+
+from dfs_tpu.config import (CDCParams, ClusterConfig, NodeConfig,
+                            ObsConfig, PeerAddr, ServeConfig)
+from dfs_tpu.node.runtime import StorageNodeServer
+from dfs_tpu.obs import new_span_id, new_trace_id
+
+ART = "OBS_r09.json"
+CDC = CDCParams(min_size=2048, avg_size=8192, max_size=65536)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+async def stitched_trace_phase(tmp: Path, data: bytes) -> dict:
+    ports = _free_ports(6)
+    peers = tuple(PeerAddr(node_id=i + 1, host="127.0.0.1",
+                           port=ports[2 * i],
+                           internal_port=ports[2 * i + 1])
+                  for i in range(3))
+    cluster = ClusterConfig(peers=peers, replication_factor=2)
+    nodes = []
+    for p in peers:
+        cfg = NodeConfig(node_id=p.node_id, cluster=cluster,
+                         data_root=tmp / "cluster", fragmenter="cdc",
+                         cdc=CDC, health_probe_s=0)
+        n = StorageNodeServer(cfg)
+        await n.start()
+        nodes.append(n)
+    try:
+        tid = new_trace_id()
+        hdr = {"X-Dfs-Trace": f"{tid}-{new_span_id()}"}
+
+        def req(port: int, method: str, path: str,
+                body: bytes | None = None) -> bytes:
+            r = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", data=body,
+                method=method, headers=hdr)
+            with urllib.request.urlopen(r, timeout=120) as resp:
+                return resp.read()
+
+        up = json.loads(await asyncio.to_thread(
+            req, peers[0].port, "POST", "/upload?name=obs.bin", data))
+        got = await asyncio.to_thread(
+            req, peers[2].port, "GET", f"/download?fileId={up['fileId']}")
+        assert got == data, "download not byte-identical"
+        trace = json.loads((await asyncio.to_thread(
+            req, peers[0].port, "GET",
+            f"/trace?traceId={tid}")).decode())
+        spans = trace["spans"]
+        ids = {s["s"]: s["node"] for s in spans}
+        cross = sum(1 for s in spans
+                    if s.get("p") in ids and ids[s["p"]] != s["node"])
+        names = {s["name"] for s in spans}
+        return {
+            "trace_id": tid,
+            "spans": len(spans),
+            "nodes_in_trace": sorted({s["node"] for s in spans}),
+            "cross_node_links": cross,
+            "http_spans": sorted(n for n in names if n.startswith("http.")),
+            "peer_spans": sorted(n for n in names if n.startswith("peer.")),
+            "stitched": (len({s["node"] for s in spans}) >= 2
+                         and cross >= 1
+                         and "http./upload" in names
+                         and "http./download" in names),
+        }
+    finally:
+        for n in nodes:
+            await n.stop()
+
+
+async def _hot_read_gibps(node: StorageNodeServer, file_id: str,
+                          size: int, readers: int, rounds: int) -> float:
+    """Aggregate GiB/s of concurrent cached whole-file range reads, each
+    entered through a request span exactly like the HTTP layer."""
+    async def read_once() -> None:
+        with node.obs.request_span("http./download"):
+            _, data, _, _ = await node.download_range(file_id, 0, size - 1)
+        assert len(data) == size
+
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        await asyncio.gather(*(read_once() for _ in range(readers)))
+    dt = time.perf_counter() - t0
+    return readers * rounds * size / dt / 2**30
+
+
+async def overhead_phase(tmp: Path, data: bytes, readers: int,
+                         rounds: int, repeats: int) -> dict:
+    """Best-of alternating arms: tracing on (default ObsConfig) vs
+    trace_ring=0, identical node/workload otherwise."""
+    results: dict[str, list[float]] = {"on": [], "off": []}
+    serve = ServeConfig(cache_bytes=max(256 * 2**20, 4 * len(data)))
+    for arm, obs_cfg in (("off", ObsConfig(trace_ring=0)),
+                         ("on", ObsConfig())):
+        ports = _free_ports(2)
+        cluster = ClusterConfig(peers=(PeerAddr(
+            node_id=1, host="127.0.0.1", port=ports[0],
+            internal_port=ports[1]),), replication_factor=1)
+        cfg = NodeConfig(node_id=1, cluster=cluster,
+                         data_root=tmp / f"hot_{arm}", fragmenter="cdc",
+                         cdc=CDC, serve=serve, obs=obs_cfg,
+                         health_probe_s=0)
+        node = StorageNodeServer(cfg)
+        await node.start()
+        try:
+            m, _ = await node.upload(data, "hot.bin")
+            size = len(data)
+            await _hot_read_gibps(node, m.file_id, size, 4, 1)  # warm
+            for _ in range(repeats):
+                results[arm].append(await _hot_read_gibps(
+                    node, m.file_id, size, readers, rounds))
+        finally:
+            await node.stop()
+        log(f"phase 2 arm={arm}: " + ", ".join(
+            f"{x:.3f}" for x in results[arm]) + " GiB/s")
+    on, off = max(results["on"]), max(results["off"])
+    overhead_pct = (off - on) / off * 100.0
+    return {"readers": readers, "rounds": rounds, "repeats": repeats,
+            "traced_gibps": round(on, 4),
+            "untraced_gibps": round(off, 4),
+            "overhead_pct": round(overhead_pct, 3),
+            "within_2pct": overhead_pct <= 2.0}
+
+
+async def run(total: int, readers: int, tmp: Path) -> dict:
+    rng = np.random.default_rng(9)
+    data = rng.integers(0, 256, size=total, dtype=np.uint8).tobytes()
+    out: dict = {"metric": "obs_trace_overhead", "round": 9,
+                 "workload": {"file_bytes": total, "readers": readers,
+                              "cdc": {"min": CDC.min_size,
+                                      "avg": CDC.avg_size,
+                                      "max": CDC.max_size}}}
+    out["stitch"] = await stitched_trace_phase(tmp, data[:4 * 2**20])
+    log(f"phase 1: {out['stitch']['spans']} spans across nodes "
+        f"{out['stitch']['nodes_in_trace']}, "
+        f"{out['stitch']['cross_node_links']} cross-node links")
+    out["overhead"] = await overhead_phase(tmp, data, readers,
+                                           rounds=3, repeats=3)
+    log(f"phase 2: traced {out['overhead']['traced_gibps']} vs untraced "
+        f"{out['overhead']['untraced_gibps']} GiB/s "
+        f"({out['overhead']['overhead_pct']}% overhead)")
+    out["ok"] = bool(out["stitch"]["stitched"]
+                     and out["overhead"]["within_2pct"])
+    return out
+
+
+def main() -> int:
+    total = int(sys.argv[1]) if len(sys.argv) > 1 else 32 * 2**20
+    readers = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="bench_obs_") as tmp:
+        out = asyncio.run(run(total, readers, Path(tmp)))
+    Path(__file__).parent.joinpath(ART).write_text(
+        json.dumps(out, indent=2) + "\n")
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

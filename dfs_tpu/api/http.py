@@ -223,11 +223,17 @@ def make_http_handler(node: "StorageNodeServer"):
     return handler
 
 
+# routes whose (fixed) path may become a span name; anything else is
+# "http.other" so an attacker-chosen path can never mint span names
+_TRACED_ROUTES = frozenset({
+    "/status", "/files", "/metrics", "/manifest", "/chunking", "/missing",
+    "/upload_resume", "/upload", "/download", "/scrub", "/repair",
+    "/trace"})
+
+
 async def _serve_one(node: "StorageNodeServer",
                      reader: asyncio.StreamReader) -> bytes:
-    from dfs_tpu.node.runtime import (DownloadError, NotFoundError,
-                                      RangeNotSatisfiable, UploadError)
-    from dfs_tpu.serve import ShedError
+    from dfs_tpu.obs import parse_http_trace
 
     request_line = (await reader.readline()).decode("latin-1").strip()
     if not request_line:
@@ -242,6 +248,7 @@ async def _serve_one(node: "StorageNodeServer",
 
     content_length: int | None = None
     range_header: str | None = None
+    trace_header: str | None = None
     chunked = False
     while True:
         line = (await reader.readline()).decode("latin-1")
@@ -261,10 +268,36 @@ async def _serve_one(node: "StorageNodeServer",
                     return plain(400, "Bad Content-Length")
             elif key == "range":
                 range_header = v.strip()
+            elif key == "x-dfs-trace":
+                # distributed-tracing carrier (docs/observability.md):
+                # "<trace32hex>-<span16hex>"; absent or malformed simply
+                # roots a fresh trace — a bad header never fails a request
+                trace_header = v.strip()
             elif key == "transfer-encoding":
                 chunked = "chunked" in v.strip().lower()
 
     node.counters.inc("http_requests")
+
+    # the request span: every downstream hop (rpc calls, CAS pool jobs,
+    # admission waits) inherits its context via contextvars and parents
+    # to it. Streamed-download bodies outlive the span (it covers work
+    # up to the response head + first batch) — docs/observability.md.
+    name = f"http.{path}" if path in _TRACED_ROUTES else "http.other"
+    with node.obs.request_span(name, parse_http_trace(trace_header)) as sp:
+        out = await _route(node, reader, method, path, query,
+                           content_length, range_header, chunked)
+        if isinstance(out, (bytes, bytearray)):
+            sp.bytes = len(out)
+        return out
+
+
+async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
+                 method: str, path: str, query: dict,
+                 content_length: int | None, range_header: str | None,
+                 chunked: bool):
+    from dfs_tpu.node.runtime import (DownloadError, NotFoundError,
+                                      RangeNotSatisfiable, UploadError)
+    from dfs_tpu.serve import ShedError
 
     if method == "GET" and path == "/status":
         return plain(200, "OK")  # exact reference reply, StorageNode.java:73
@@ -273,6 +306,13 @@ async def _serve_one(node: "StorageNodeServer",
         return as_json(200, node.list_files())
 
     if method == "GET" and path == "/metrics":
+        if query.get("format") == "prom":
+            # unified Prometheus exposition: counters + stopwatches +
+            # latency HISTOGRAM BUCKETS + per-peer/op RPC series
+            from dfs_tpu.obs.prom import render_node_metrics
+
+            return _resp(200, render_node_metrics(node).encode(),
+                         "text/plain; version=0.0.4; charset=utf-8")
         snap = node.counters.snapshot()
         snap["nodeId"] = node.cfg.node_id
         snap["underReplicated"] = len(node.under_replicated)
@@ -281,7 +321,19 @@ async def _serve_one(node: "StorageNodeServer",
         snap["serve"] = node.serve.stats()   # cache/flight/admission
         snap["ingest"] = node.ingest_stats()  # write-path pipeline:
         # window/credit bounds, stall attribution, CAS-tier queue/busy
+        snap["obs"] = node.obs.stats()   # trace ring + RPC tables —
+        # ADDITIVE: the pre-r09 JSON schema stays a strict subset
         return as_json(200, snap)
+
+    if method == "GET" and path == "/trace":
+        from dfs_tpu.obs import TRACE_HEX, is_id
+
+        tid = query.get("traceId")
+        if not tid or not is_id(tid, TRACE_HEX):
+            return plain(400, "Bad traceId")
+        # cluster-wide stitch by default; &cluster=0 = this ring only
+        return as_json(200, await node.trace_spans(
+            tid, cluster=query.get("cluster", "1") != "0"))
 
     if method == "GET" and path == "/manifest":
         file_id = query.get("fileId")

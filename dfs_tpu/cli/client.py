@@ -52,12 +52,26 @@ class NodeClient:
                            size=i.get("size", 0), chunks=i.get("chunks", 0))
                 for i in items]
 
-    def upload(self, data: bytes, name: str, ec: int = 0) -> dict:
+    @staticmethod
+    def _trace_headers(trace_id: str | None) -> dict:
+        """``X-Dfs-Trace`` carrier for a client-minted trace id: the
+        node tags every span the request causes (cluster-wide) with it,
+        and :meth:`trace` stitches them afterwards."""
+        if not trace_id:
+            return {}
+        from dfs_tpu.obs import new_span_id
+
+        return {"X-Dfs-Trace": f"{trace_id}-{new_span_id()}"}
+
+    def upload(self, data: bytes, name: str, ec: int = 0,
+               trace_id: str | None = None) -> dict:
         params = {"name": name}
         if ec:
             params["ec"] = str(ec)
         q = urllib.parse.urlencode(params)
-        return json.loads(self._request("POST", f"/upload?{q}", body=data))
+        return json.loads(self._request(
+            "POST", f"/upload?{q}", body=data,
+            headers=self._trace_headers(trace_id)))
 
     def upload_stream(self, blocks, name: str) -> dict:
         """Stream an upload with chunked transfer encoding (urllib sends
@@ -75,7 +89,8 @@ class NodeClient:
         return json.loads(self._request(
             "POST", "/missing", body=body))["missing"]
 
-    def upload_resume(self, data: bytes, name: str) -> dict:
+    def upload_resume(self, data: bytes, name: str,
+                      trace_id: str | None = None) -> dict:
         """Resumable upload: chunk locally with the node's advertised
         parameters, probe which digests the cluster already holds, and
         transfer ONLY the missing payloads (plus the table). A re-POST
@@ -89,7 +104,7 @@ class NodeClient:
         try:
             desc = self.chunking()
         except RuntimeError:
-            out = self.upload(data, name)
+            out = self.upload(data, name, trace_id=trace_id)
             out["clientBytesSent"] = len(data)
             return out
         frag = fragmenter_from_description(desc["describe"])
@@ -108,22 +123,25 @@ class NodeClient:
         q = urllib.parse.urlencode({"name": name})
         try:
             out = json.loads(self._request(
-                "POST", f"/upload_resume?{q}", body=body))
+                "POST", f"/upload_resume?{q}", body=body,
+                headers=self._trace_headers(trace_id)))
         except RuntimeError as e:
             if "HTTP 409" not in str(e):
                 raise
             # a probed chunk vanished between /missing and the resume
             # (aged GC of unreferenced chunks, or its holder died) —
             # degrade to the plain full upload, as documented
-            out = self.upload(data, name)
+            out = self.upload(data, name, trace_id=trace_id)
             out["clientBytesSent"] = len(body) + len(data)
             return out
         out["clientBytesSent"] = len(body)
         return out
 
-    def download(self, file_id: str) -> bytes:
+    def download(self, file_id: str,
+                 trace_id: str | None = None) -> bytes:
         q = urllib.parse.urlencode({"fileId": file_id})
-        return self._request("GET", f"/download?{q}")
+        return self._request("GET", f"/download?{q}",
+                             headers=self._trace_headers(trace_id))
 
     def download_range(self, file_id: str, start: int, end: int) -> bytes:
         """Bytes [start, end) via an HTTP Range request (206)."""
@@ -140,6 +158,17 @@ class NodeClient:
 
     def metrics(self) -> dict:
         return json.loads(self._request("GET", "/metrics"))
+
+    def metrics_prom(self) -> str:
+        """Prometheus text exposition (GET /metrics?format=prom)."""
+        return self._request("GET", "/metrics?format=prom").decode()
+
+    def trace(self, trace_id: str, cluster: bool = True) -> dict:
+        """Spans of one trace, stitched cluster-wide by the contacted
+        node (GET /trace) — render with dfs_tpu.obs.stitch.render_tree."""
+        q = urllib.parse.urlencode({"traceId": trace_id,
+                                    "cluster": "1" if cluster else "0"})
+        return json.loads(self._request("GET", f"/trace?{q}"))
 
     def delete(self, file_id: str) -> str:
         q = urllib.parse.urlencode({"fileId": file_id})

@@ -18,7 +18,7 @@ from pathlib import Path
 
 from dfs_tpu.cli.client import NodeClient
 from dfs_tpu.config import (CDCParams, ClusterConfig, IngestConfig,
-                            NodeConfig, ServeConfig)
+                            NodeConfig, ObsConfig, ServeConfig)
 
 
 def _client(args) -> NodeClient:
@@ -63,7 +63,9 @@ def cmd_serve(args) -> int:
                             flush_bytes=args.ingest_flush_bytes,
                             credit_bytes=args.ingest_credit_bytes,
                             slice_inflight=args.replicate_inflight,
-                            cas_io_threads=args.cas_io_threads))
+                            cas_io_threads=args.cas_io_threads),
+        obs=ObsConfig(trace_ring=args.trace_ring,
+                      slow_span_s=args.slow_span))
 
     async def run() -> None:
         from dfs_tpu.utils.aio import create_logged_task
@@ -151,10 +153,21 @@ def cmd_list(args) -> int:
     return 0
 
 
+def _maybe_trace_id(args) -> str | None:
+    """--trace: mint a client-side trace id the node(s) will tag every
+    span of this request with — inspect afterwards via `trace <id>`."""
+    if not getattr(args, "trace", False):
+        return None
+    from dfs_tpu.obs import new_trace_id
+
+    return new_trace_id()
+
+
 def cmd_upload(args) -> int:
     path = Path(args.file)
     data = path.read_bytes()
     ec = getattr(args, "ec", 0)
+    trace_id = _maybe_trace_id(args)
     if getattr(args, "resume", False):
         if ec:
             print("--ec and --resume are mutually exclusive "
@@ -162,14 +175,19 @@ def cmd_upload(args) -> int:
                   file=sys.stderr)
             return 2
         # chunk locally, probe, send only missing payloads (SURVEY §5.4)
-        info = _client(args).upload_resume(data, name=path.name)
+        info = _client(args).upload_resume(data, name=path.name,
+                                           trace_id=trace_id)
+        tr = f" traceId={trace_id}" if trace_id else ""
         print(f"Uploaded (resume): fileId={info['fileId']} "
               f"chunks={info['chunks']} "
-              f"clientSent={info['clientBytesSent']}B of {len(data)}B")
+              f"clientSent={info['clientBytesSent']}B of {len(data)}B{tr}")
         return 0
-    info = _client(args).upload(data, name=path.name, ec=ec)
+    info = _client(args).upload(data, name=path.name, ec=ec,
+                                trace_id=trace_id)
     extra = (f" ecParity={info['ecParityBytes']}B"
              if "ecParityBytes" in info else "")
+    if trace_id:
+        extra += f" traceId={trace_id}"
     print(f"Uploaded: fileId={info['fileId']} chunks={info['chunks']} "
           f"transferred={info.get('transferredBytes', '?')}B "
           f"dedupSkipped={info.get('dedupSkippedBytes', '?')}B{extra}")
@@ -179,7 +197,10 @@ def cmd_upload(args) -> int:
 def cmd_download(args) -> int:
     c = _client(args)
     file_id = args.file_id
-    data = c.download(file_id)
+    trace_id = _maybe_trace_id(args)
+    data = c.download(file_id, trace_id=trace_id)
+    if trace_id:
+        print(f"traceId={trace_id}")
     # Resolve the friendly name like the reference client (downloads/<name>,
     # Client.java:214-219).
     name = file_id
@@ -201,7 +222,26 @@ def cmd_delete(args) -> int:
 
 def cmd_metrics(args) -> int:
     import json
+    if getattr(args, "prom", False):
+        print(_client(args).metrics_prom(), end="")
+        return 0
     print(json.dumps(_client(args).metrics(), indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Stitch + render one distributed trace (docs/observability.md):
+    the contacted node gathers every peer's spans for the id and this
+    renders the cross-node tree with a slow-span log on top."""
+    from dfs_tpu.obs.stitch import render_tree
+
+    data = _client(args).trace(args.trace_id)
+    slow = args.slow if args.slow is not None \
+        else float(data.get("slowSpanS", 1.0))
+    print(render_tree(data.get("spans", []), slow_s=slow))
+    if data.get("peersFailed"):
+        print(f"(warning: {data['peersFailed']} peer(s) unreachable — "
+              "trace may be partial)", file=sys.stderr)
     return 0
 
 
@@ -347,6 +387,12 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--cas-io-threads", type=int, default=4,
                        help="async CAS tier worker threads (local chunk "
                             "file I/O off the event loop)")
+    serve.add_argument("--trace-ring", type=int, default=2048,
+                       help="finished-span ring capacity (distributed "
+                            "tracing); 0 disables tracing entirely")
+    serve.add_argument("--slow-span", type=float, default=1.0,
+                       help="slow-span threshold (s) for the trace "
+                            "stitcher's slow-request log")
     serve.set_defaults(fn=cmd_serve)
 
     sc = sub.add_parser("sidecar", help="run the chunk+hash sidecar service")
@@ -370,15 +416,31 @@ def build_parser() -> argparse.ArgumentParser:
                     help="erasure-code with K data shards + P/Q parity "
                          "per stripe (needs K+2 cluster nodes; any two "
                          "lost shards per stripe are recoverable)")
+    up.add_argument("--trace", action="store_true",
+                    help="tag the request with a fresh trace id "
+                         "(printed) for `trace <id>` inspection")
     up.set_defaults(fn=cmd_upload)
     down = sub.add_parser("download")
     down.add_argument("file_id")
     down.add_argument("--out", default=None)
+    down.add_argument("--trace", action="store_true",
+                      help="tag the request with a fresh trace id "
+                           "(printed) for `trace <id>` inspection")
     down.set_defaults(fn=cmd_download)
     rm = sub.add_parser("delete")
     rm.add_argument("file_id")
     rm.set_defaults(fn=cmd_delete)
-    sub.add_parser("metrics").set_defaults(fn=cmd_metrics)
+    mt = sub.add_parser("metrics")
+    mt.add_argument("--prom", action="store_true",
+                    help="Prometheus text exposition instead of JSON")
+    mt.set_defaults(fn=cmd_metrics)
+    tr = sub.add_parser("trace",
+                        help="render a stitched cross-node trace")
+    tr.add_argument("trace_id")
+    tr.add_argument("--slow", type=float, default=None,
+                    help="slow-span threshold (s); default: the node's "
+                         "configured slow_span_s")
+    tr.set_defaults(fn=cmd_trace)
     sub.add_parser("menu").set_defaults(fn=cmd_menu)
     return ap
 

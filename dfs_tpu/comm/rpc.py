@@ -21,6 +21,7 @@ Ops mirror the reference's internal API one-to-one:
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Any
 
 from dfs_tpu.comm.wire import (WireError, pack_chunks, read_msg, send_msg,
@@ -54,10 +55,16 @@ class InternalClient:
 
     def __init__(self, connect_timeout_s: float = 2.0,
                  request_timeout_s: float = 10.0, retries: int = 3,
-                 coalesce_fetches: bool = False) -> None:
+                 coalesce_fetches: bool = False, obs=None) -> None:
         self.connect_timeout_s = connect_timeout_s
         self.request_timeout_s = request_timeout_s
         self.retries = retries
+        # Observability hook (dfs_tpu.obs): when set, every call records
+        # per-peer per-op client metrics, opens an `rpc.<op>` span, and
+        # attaches the trace context to the wire header so the peer's
+        # server span parents to it. None (the pre-r09 behavior, and
+        # what standalone tools get) changes nothing on the wire.
+        self._obs = obs
         self._pool: dict[tuple[str, int],
                          list[tuple[asyncio.StreamReader,
                                     asyncio.StreamWriter]]] = {}
@@ -169,10 +176,47 @@ class InternalClient:
         ``retries`` overrides the default — the node runtime passes 1 for
         peers its health monitor believes are dead (fast-fail probe).
         ``timeout_s`` raises (never lowers) the per-attempt budget —
-        bulk ops pass a size-derived value (:meth:`_bulk_timeout`)."""
+        bulk ops pass a size-derived value (:meth:`_bulk_timeout`).
+
+        With an obs hook: opens an ``rpc.<op>`` span, propagates the
+        trace context in the header's optional ``trace`` field (peers
+        that predate the field ignore it), and records per-peer per-op
+        count/latency/bytes/errors into the client RPC table."""
+        obs = self._obs
+        if obs is None:
+            return await self._call_retrying(peer, header, body, retries,
+                                             timeout_s)
+        op = str(header.get("op"))
+        with obs.span(f"rpc.{op}", peer=peer.node_id) as sp:
+            # attach INSIDE the span: the rpc span's own id is what the
+            # peer's server span must parent to
+            tr = obs.wire_trace()
+            if tr is not None:
+                header["trace"] = tr
+            t0 = time.perf_counter()
+            nb_in = 0
+            failed = True
+            try:
+                resp, rbody = await self._call_retrying(
+                    peer, header, body, retries, timeout_s)
+                nb_in = len(rbody)
+                failed = False
+                sp.bytes = len(body) + nb_in
+                return resp, rbody
+            finally:
+                obs.rpc_client.record(
+                    peer.node_id, op, time.perf_counter() - t0,
+                    bytes_out=len(body), bytes_in=nb_in, error=failed)
+
+    async def _call_retrying(self, peer: PeerAddr, header: dict,
+                             body: bytes, retries: int | None,
+                             timeout_s: float | None) -> tuple[dict, bytes]:
         attempts = retries if retries is not None else self.retries
+        op = header.get("op")
         last: Exception | None = None
         for attempt in range(attempts):
+            if attempt and self._obs is not None:
+                self._obs.rpc_client.retry(peer.node_id, str(op))
             try:
                 return await self._call_once(peer, header, body, timeout_s)
             except RpcError:
@@ -261,7 +305,13 @@ class InternalClient:
             # raises whatever RpcError the leader rejected with — never
             # the leader's own CancelledError (converted below), so a
             # coalesced caller whose request is alive falls back to the
-            # next replica like any failed fetch
+            # next replica like any failed fetch. The wait gets its own
+            # span: a coalesced caller's trace must show WHERE its
+            # latency went (waiting on another flight, not the wire).
+            if self._obs is not None:
+                with self._obs.span("rpc.get_chunk.wait",
+                                    peer=peer.node_id):
+                    return await self._flight.wait(fut)
             return await self._flight.wait(fut)
         try:
             _, body = await self.call(

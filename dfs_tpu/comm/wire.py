@@ -13,6 +13,14 @@ which inflates replication traffic ~33% and breaks on escaped quotes
 
 Chunk batches put (digest, length) pairs in the header and concatenate the
 raw chunk bytes in the body — zero encoding overhead.
+
+Since round 9 the header MAY carry an OPTIONAL ``trace`` field —
+``{"t": <trace32hex>, "s": <span16hex>, "f": <sender node id>}`` — the
+distributed-tracing context (docs/observability.md). Compatibility is
+bidirectional by construction: receivers that predate the field ignore
+unknown header keys, and receivers that understand it treat a frame
+without (or with a malformed) ``trace`` exactly like one from an
+untraced caller. The field never affects op semantics.
 """
 
 from __future__ import annotations

@@ -170,6 +170,30 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """Observability (dfs_tpu.obs): distributed tracing + unified metrics.
+
+    Unlike the serve/ingest knobs, tracing defaults ON — the Dapper
+    lesson is that always-on cheap tracing is what makes the *one* slow
+    request diagnosable after the fact. ``trace_ring=0`` disables span
+    collection AND context propagation entirely (the wire/header trace
+    carriers are simply never attached); that is the control arm of the
+    OBS_r09.json overhead measurement. RPC metrics stay on either way.
+    """
+
+    trace_ring: int = 2048      # finished-span ring capacity per node;
+                                # 0 = tracing fully off
+    slow_span_s: float = 1.0    # threshold for the stitcher's
+                                # slow-request log (trace <id> CLI)
+
+    def __post_init__(self) -> None:
+        if self.trace_ring < 0:
+            raise ValueError("trace_ring must be >= 0")
+        if self.slow_span_s <= 0:
+            raise ValueError("slow_span_s must be > 0")
+
+
+@dataclasses.dataclass(frozen=True)
 class IngestConfig:
     """Pipelined write path (docs/ingest.md) — the knobs bounding how much
     of the three-stage ingest pipeline (fragmentation, local CAS writes,
@@ -242,6 +266,9 @@ class NodeConfig:
     # write-path pipeline bounds (window / credits / per-peer slices);
     # IngestConfig(window=1, slice_inflight=1) = the serial write path
     ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
+    # observability: span ring + slow threshold; ObsConfig(trace_ring=0)
+    # turns tracing fully off (metrics remain)
+    obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
 
     @property
     def self_addr(self) -> PeerAddr:

@@ -25,6 +25,7 @@ Deliberate upgrades over the reference, per SURVEY.md §2.5 / §5.3:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import math
 import threading
 import time
@@ -42,6 +43,7 @@ from dfs_tpu.meta.manifest import (ChunkRef, EcInfo, Manifest, StripeRef,
 from dfs_tpu.node.health import HealthMonitor
 from dfs_tpu.node.placement import (ec_shard_node, handoff_order,
                                     replica_set)
+from dfs_tpu.obs import Observability, Span, parse_wire_trace
 from dfs_tpu.serve import BatchPrefetcher, ServingTier
 from dfs_tpu.store.aio import AsyncChunkStore
 from dfs_tpu.store.cas import NodeStore
@@ -49,7 +51,7 @@ from dfs_tpu.utils.hashing import (is_hex_digest, sha256_hex,
                                    sha256_many_hex, sha256_new)
 from dfs_tpu.utils.aio import gather_abort_siblings
 from dfs_tpu.utils.logging import Counters, Stopwatches, get_logger
-from dfs_tpu.utils.trace import LatencyRecorder, span
+from dfs_tpu.utils.trace import LatencyRecorder
 
 
 class UploadError(RuntimeError):
@@ -149,7 +151,14 @@ def ec_shard_items(manifest: Manifest) -> list[tuple[str, int]]:
 # move/hash chunk payloads. Everything else (health, has_chunks,
 # tombstones, list/get_manifest, announce, delete) is cheap metadata
 # whose timeliness other subsystems depend on — see _handle_internal.
+# The same set decides which UNTRACED inbound ops still root a fresh
+# trace (heavy work stays diagnosable; probe noise stays out of the
+# span ring).
 _HEAVY_OPS = frozenset({"store_chunks", "get_chunk", "get_chunks"})
+
+# annotation sink for inbound ops that record no span (untraced cheap
+# ops) — writes are discarded, same contract as obs._NULL_SPAN
+_NULL_OBS_SPAN = Span()
 
 
 class ByteBudget:
@@ -199,11 +208,19 @@ class StorageNodeServer:
     def __init__(self, cfg: NodeConfig) -> None:
         self.cfg = cfg
         self.store = NodeStore(cfg.data_root, cfg.node_id)
+        self.counters = Counters()
+        self.latency = LatencyRecorder()
+        # observability: trace-context propagation + span ring + RPC
+        # metric tables (dfs_tpu.obs). Built FIRST — the client, CAS
+        # tier, and serving tier all take it as their tracing hook.
+        self.obs = Observability(cfg.obs, cfg.node_id,
+                                 latency=self.latency)
         # async CAS tier: every event-loop chunk put/get routes through a
         # bounded thread pool (store/aio.py) — the loop never blocks on
         # chunk file I/O and disk concurrency is explicit
         self.cas = AsyncChunkStore(self.store.chunks,
-                                   workers=cfg.ingest.cas_io_threads)
+                                   workers=cfg.ingest.cas_io_threads,
+                                   obs=self.obs)
         # streaming-ingest flush size: config-driven, kept as an instance
         # attribute so tests/benches can still scale it per node
         self._STREAM_FLUSH_BYTES = cfg.ingest.flush_bytes
@@ -220,18 +237,16 @@ class StorageNodeServer:
         self.client = InternalClient(cfg.connect_timeout_s,
                                      cfg.request_timeout_s, cfg.retries,
                                      coalesce_fetches=cfg.serve.cache_bytes
-                                     > 0)
+                                     > 0, obs=self.obs)
         self.health = HealthMonitor(cfg.cluster, cfg.node_id, self.client,
                                     probe_interval_s=cfg.health_probe_s)
-        self.counters = Counters()
-        self.latency = LatencyRecorder()
         # write-path stall attribution (time blocked on credits vs
         # replication vs disk) + pipeline-depth peaks — /metrics "ingest"
         self.ingest_stalls = Stopwatches()
         # read-path serving tier: hot-chunk cache + single-flight +
         # admission gates + readahead. Default config = every component
         # off, and the node runs the historical code paths exactly.
-        self.serve = ServingTier(cfg.serve)
+        self.serve = ServingTier(cfg.serve, obs=self.obs)
         self.log = get_logger("node", cfg.node_id)
         self.under_replicated: set[str] = set()  # digests needing repair
         self._internal_server: asyncio.AbstractServer | None = None
@@ -283,23 +298,51 @@ class StorageNodeServer:
                     header, body = await read_msg(reader)
                 except WireError:
                     return
-                try:
-                    gate = self.serve.admission.internal
-                    if gate.enabled and header.get("op") in _HEAVY_OPS:
-                        # bounded storage-plane concurrency for the BULK
-                        # ops only; a shed op surfaces to the peer as an
-                        # application error (RpcRemoteError — live peer,
-                        # not a death sign). Cheap O(1)/metadata ops —
-                        # health above all — bypass the gate: a health
-                        # probe queued behind multi-second transfers
-                        # past the prober's timeout would make a merely
-                        # BUSY node look dead and trigger repair churn.
-                        async with gate.slot():
+                # trace context off the wire: the OPTIONAL `trace` field
+                # names the caller's rpc span — this op's span (and every
+                # span it opens downstream: cas, admission waits) parents
+                # to it, which is what makes cluster stitching possible.
+                # Absent/malformed (pre-r09 peers) roots a fresh trace —
+                # but only for the HEAVY ops: rooting every untraced
+                # health probe / background repair call would mint a
+                # steady stream of unqueryable single-span traces that
+                # evict client-tagged spans from the bounded ring (the
+                # same probe-noise reasoning that exempts cheap ops from
+                # the internal admission gate).
+                op = header.get("op")
+                tr = parse_wire_trace(header.get("trace"))
+                t0 = time.perf_counter()
+                with (self.obs.server_span(f"peer.{op}", tr)
+                      if tr is not None or op in _HEAVY_OPS
+                      else contextlib.nullcontext(_NULL_OBS_SPAN)) as sp:
+                    sp.bytes = len(body)
+                    try:
+                        gate = self.serve.admission.internal
+                        if gate.enabled and op in _HEAVY_OPS:
+                            # bounded storage-plane concurrency for the
+                            # BULK ops only; a shed op surfaces to the
+                            # peer as an application error
+                            # (RpcRemoteError — live peer, not a death
+                            # sign). Cheap O(1)/metadata ops — health
+                            # above all — bypass the gate: a health
+                            # probe queued behind multi-second transfers
+                            # past the prober's timeout would make a
+                            # merely BUSY node look dead and trigger
+                            # repair churn.
+                            async with gate.slot():
+                                resp, rbody = await self._dispatch(header,
+                                                                   body)
+                        else:
                             resp, rbody = await self._dispatch(header, body)
-                    else:
-                        resp, rbody = await self._dispatch(header, body)
-                except Exception as e:  # noqa: BLE001 - report to peer
-                    resp, rbody = {"ok": False, "error": str(e)}, b""
+                        sp.bytes += len(rbody)
+                    except Exception as e:  # noqa: BLE001 - report to peer
+                        sp.err = type(e).__name__
+                        resp, rbody = {"ok": False, "error": str(e)}, b""
+                self.obs.rpc_server.record(
+                    tr[2] if tr is not None and tr[2] is not None else "-",
+                    str(op), time.perf_counter() - t0,
+                    bytes_out=len(rbody), bytes_in=len(body),
+                    error=not resp.get("ok", False))
                 await send_msg(writer, resp, rbody)
         except (ConnectionError, OSError):
             pass
@@ -398,6 +441,11 @@ class StorageNodeServer:
         if op == "delete":
             self._forget_file(header["fileId"])
             return {"ok": True}, b""
+        if op == "get_trace":
+            # span query for cross-node stitching (trace_spans below):
+            # cheap metadata (bounded ring scan), ungated like health
+            return {"ok": True, "spans": self.obs.spans_for(
+                str(header.get("traceId", "")))}, b""
         if op == "health":
             # counts must be O(1)/filename-only: every peer probes this
             # op every few seconds, and the full digests()+manifest-parse
@@ -423,11 +471,11 @@ class StorageNodeServer:
         # MiB body would otherwise stall every concurrent request for the
         # full CPU pass (the reference is thread-per-connection so it
         # never noticed; an asyncio node must not block its loop)
-        with span("upload.hash_file", self.latency):
+        with self.obs.span("upload.hash_file", latency=True):
             file_id = await asyncio.to_thread(sha256_hex, data)
         if not name:
             name = f"file-{file_id[:8]}"  # reference default, StorageNode.java:133-135
-        with span("upload.fragment", self.latency):
+        with self.obs.span("upload.fragment", latency=True):
             manifest = await asyncio.to_thread(
                 self.fragmenter.manifest, data, name=name, file_id=file_id)
 
@@ -456,7 +504,7 @@ class StorageNodeServer:
                 # beyond k=255 they repeat and some double erasures
                 # become uncorrectable — the any-2-lost guarantee fails
                 raise UploadError("ec must be <= 255", status=400)
-            with span("upload.ec_encode", self.latency):
+            with self.obs.span("upload.ec_encode", latency=True):
                 manifest, parity = await asyncio.to_thread(
                     self._ec_extend, manifest, data, ec_k)
             for d, b in parity:
@@ -1064,7 +1112,7 @@ class StorageNodeServer:
                     # an application error came from a live peer
                     self.health.mark_dead(node_id)
 
-        with span("upload.replicate", self.latency):
+        with self.obs.span("upload.replicate", latency=True):
             await gather_abort_siblings(
                 put_local(local_puts),
                 *(replicate(nid, w) for nid, w in per_node.items()))
@@ -1085,7 +1133,7 @@ class StorageNodeServer:
         handoff: set[str] = set()
         next_try = {d: len(primary_targets(d))       # ring index per digest
                     for d in copies}
-        with span("upload.handoff", self.latency):
+        with self.obs.span("upload.handoff", latency=True):
             while True:
                 need = [d for d, n in copies.items() if n < quorum]
                 if not need:
@@ -1693,15 +1741,21 @@ class StorageNodeServer:
                     serve.flight.resolve(d, b)
                     out[d] = b
         failed_waits: list[str] = []
-        for d, fut in waits.items():
-            try:
-                out[d] = await serve.flight.wait(fut)
-            except DownloadError:
-                failed_waits.append(d)
-            except asyncio.CancelledError:
-                if not fut.done():
-                    raise                # WE were cancelled
-                failed_waits.append(d)   # the leader's flight died
+        if waits:
+            # traced as ONE wait span (not per digest): what matters
+            # post-hoc is how long this reader was parked behind other
+            # flights, and a span per coalesced digest would dominate
+            # the ring on hot files
+            with self.obs.span("serve.flight.wait"):
+                for d, fut in waits.items():
+                    try:
+                        out[d] = await serve.flight.wait(fut)
+                    except DownloadError:
+                        failed_waits.append(d)
+                    except asyncio.CancelledError:
+                        if not fut.done():
+                            raise            # WE were cancelled
+                        failed_waits.append(d)  # the leader's flight died
         if failed_waits:
             # a rejected flight says nothing about THIS request: the
             # leader may simply have been cancelled (its client hung
@@ -1832,7 +1886,7 @@ class StorageNodeServer:
     async def download(self, file_id: str) -> tuple[Manifest, bytes]:
         manifest = await self._resolve_manifest(file_id)
 
-        with span("download.gather", self.latency):
+        with self.obs.span("download.gather", latency=True):
             if self.serve.read_path_enabled:
                 # cache + single-flight front; the whole-file hash gate
                 # below still guards assembly exactly as before
@@ -1867,6 +1921,40 @@ class StorageNodeServer:
                 "sliceInflight": ing.slice_inflight,
                 "stalls": self.ingest_stalls.snapshot(),
                 "cas": self.cas.stats()}
+
+    async def trace_spans(self, trace_id: str,
+                          cluster: bool = True) -> dict:
+        """Spans of one trace — local ring, plus (``cluster=True``) every
+        peer's ring via the ``get_trace`` op, merged for the stitcher
+        (GET /trace, CLI ``trace <id>``). Unreachable peers degrade the
+        result to a partial trace (reported in ``peersFailed``), never
+        an error: a stitch query must work exactly when something is
+        wrong."""
+        from dfs_tpu.obs.stitch import merge_spans
+
+        lists: list[list[dict]] = [self.obs.spans_for(trace_id)]
+        failed = 0
+        peers = self._peers() if cluster else []
+
+        async def one(peer) -> list[dict] | None:
+            try:
+                resp, _ = await self.client.call(
+                    peer, {"op": "get_trace", "traceId": trace_id},
+                    retries=1)
+                spans = resp.get("spans")
+                return spans if isinstance(spans, list) else []
+            except RpcError:
+                return None
+
+        for got in await asyncio.gather(*(one(p) for p in peers)):
+            if got is None:
+                failed += 1
+            else:
+                lists.append(got)
+        return {"traceId": trace_id,
+                "slowSpanS": self.cfg.obs.slow_span_s,
+                "spans": merge_spans(lists),
+                "peersQueried": len(peers), "peersFailed": failed}
 
     def list_files(self) -> list[dict]:
         return [{"fileId": m.file_id, "name": m.name, "size": m.size,

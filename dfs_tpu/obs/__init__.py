@@ -1,0 +1,357 @@
+"""Request-scoped distributed tracing + unified metrics (docs/observability.md).
+
+The reference system has only ``printf`` logging (SURVEY.md §5.5); this
+node until round 9 had three disconnected metric registries and zero
+request correlation across nodes — a slow multi-peer download (gather →
+``_fetch_chunk`` → peer get → singleflight wait) was undiagnosable. This
+package is the Dapper-shaped fix (Sigelman et al., 2010; Canopy, Kaldor
+et al., SOSP 2017): cheap ALWAYS-ON trace contexts propagated on every
+hop, collected in a bounded per-node ring, stitched post-hoc.
+
+Three pieces:
+
+- **Trace context** — a ``(trace_id, span_id)`` pair carried in a
+  :mod:`contextvars` variable, so every async hop of a request (placement
+  tasks, the async CAS pool await, singleflight waiters, admission queue
+  waits) inherits it without plumbing. It crosses processes as the
+  ``X-Dfs-Trace: <trace32hex>-<span16hex>`` HTTP header (api/http.py) and
+  as an OPTIONAL ``trace`` field ``{"t","s","f"}`` in the storage-plane
+  JSON wire header (comm/rpc.py) — old peers ignore the field, new peers
+  tolerate its absence (backward compatible by construction).
+- **Span collection** — :meth:`Observability.span` records finished
+  spans (name, ids, wall start, duration, peer, bytes, error) into a
+  bounded ring (``ObsConfig.trace_ring`` entries; 0 disables tracing
+  entirely and the context var is never even read). Served at
+  ``GET /trace?traceId=…`` and stitched cluster-wide by
+  :mod:`dfs_tpu.obs.stitch` + the ``trace <id>`` CLI subcommand.
+- **Unified metrics** — :class:`RpcStats` (per-peer per-op RPC
+  count/latency/bytes/errors/retries, client and server side) and the
+  Prometheus text exposition (:mod:`dfs_tpu.obs.prom`) flattening every
+  registry, histogram buckets included, at ``GET /metrics?format=prom``.
+
+Cost discipline: with ``trace_ring=0`` every tracing call is one ``is
+None`` branch; with it on, an untraced call path (no inbound context,
+not an entry point) pays one ContextVar read. OBS_r09.json holds the
+measured hot-read overhead (≤2% vs ``trace_ring=0``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import threading
+import time
+from collections import deque
+
+from dfs_tpu.utils import trace as _trace_mod
+from dfs_tpu.utils.logging import capped_key
+from dfs_tpu.utils.trace import LatencyRecorder
+
+# the current (trace_id, span_id) of this task/thread, or None when the
+# request was never traced. ContextVar semantics give the propagation
+# for free: asyncio.create_task / asyncio.to_thread copy the context, so
+# placement windows and worker-thread hops inherit the ids.
+_ctx: contextvars.ContextVar[tuple[str, str] | None] = \
+    contextvars.ContextVar("dfs_trace_ctx", default=None)
+
+TRACE_HEX = 32   # 16 random bytes
+SPAN_HEX = 16    # 8 random bytes
+
+
+def new_trace_id() -> str:
+    return os.urandom(TRACE_HEX // 2).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(SPAN_HEX // 2).hex()
+
+
+def current() -> tuple[str, str] | None:
+    """(trace_id, span_id) active in this context, or None."""
+    return _ctx.get()
+
+
+_HEX = frozenset("0123456789abcdef")
+
+
+def is_id(s, n: int) -> bool:
+    """Exactly ``n`` lowercase hex chars — the canonical id form
+    (os.urandom().hex()). Strict charset on purpose: int(s, 16) also
+    accepts '0x'/sign/underscore forms that would let malformed ids
+    slip into rings and wire fields."""
+    return isinstance(s, str) and len(s) == n and set(s) <= _HEX
+
+
+def parse_http_trace(value: str | None) -> tuple[str, str] | None:
+    """``X-Dfs-Trace`` header value ``<trace>-<span>`` -> (trace_id,
+    parent_span_id), or None for absent/malformed (never raises — a bad
+    header must not fail the request it rides on)."""
+    if not value:
+        return None
+    t, sep, s = value.strip().partition("-")
+    if sep and is_id(t, TRACE_HEX) and is_id(s, SPAN_HEX):
+        return t, s
+    return None
+
+
+def parse_wire_trace(field) -> tuple[str, str, int | None] | None:
+    """Wire-header ``trace`` field ``{"t","s"[,"f"]}`` -> (trace_id,
+    parent_span_id, sender node id or None). None for absent/malformed
+    — pre-r09 peers simply never send the field."""
+    if not isinstance(field, dict):
+        return None
+    t, s = field.get("t"), field.get("s")
+    if not (is_id(t, TRACE_HEX) and is_id(s, SPAN_HEX)):
+        return None
+    f = field.get("f")
+    return t, s, (f if isinstance(f, int) and not isinstance(f, bool)
+                  else None)
+
+
+class Span:
+    """Mutable annotations a caller may set while its span is open."""
+
+    __slots__ = ("bytes", "err")
+
+    def __init__(self) -> None:
+        self.bytes = 0
+        self.err: str | None = None
+
+
+# shared by every no-op path; its annotations are written and discarded
+_NULL_SPAN = Span()
+
+
+class RpcStats:
+    """Per-(peer, op) RPC counters: calls, errors, retries, bytes
+    out/in, total seconds. One instance per direction (client / server).
+    Key cardinality is capped — a hostile or buggy peer label stream
+    folds into ``("_overflow", "_overflow")`` instead of growing
+    ``/metrics`` unboundedly (same discipline as Counters)."""
+
+    _MAX_KEYS = 256
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # (peer, op) -> [count, errors, retries, bytes_out, bytes_in, s]
+        self._m: dict[tuple, list] = {}
+        self._overflow_warned = False
+
+    def _row(self, peer, op) -> list:
+        key = capped_key(self._m, (peer, op), self._MAX_KEYS, self,
+                         "RpcStats", ("_overflow", "_overflow"))
+        row = self._m.get(key)
+        if row is None:
+            row = self._m[key] = [0, 0, 0, 0, 0, 0.0]
+        return row
+
+    def record(self, peer, op: str, seconds: float, bytes_out: int = 0,
+               bytes_in: int = 0, error: bool = False) -> None:
+        with self._lock:
+            row = self._row(peer, op)
+            row[0] += 1
+            if error:
+                row[1] += 1
+            row[3] += bytes_out
+            row[4] += bytes_in
+            row[5] += seconds
+
+    def retry(self, peer, op: str) -> None:
+        with self._lock:
+            self._row(peer, op)[2] += 1
+
+    def snapshot(self) -> dict:
+        """JSON /metrics shape: '<peer>:<op>' -> counters dict."""
+        with self._lock:
+            return {f"{p}:{o}": {"count": r[0], "errors": r[1],
+                                 "retries": r[2], "bytesOut": r[3],
+                                 "bytesIn": r[4],
+                                 "seconds": round(r[5], 6)}
+                    for (p, o), r in sorted(self._m.items(),
+                                            key=lambda kv: str(kv[0]))}
+
+    def rows(self) -> list[tuple[str, str, list]]:
+        """(peer, op, [count, errors, retries, bytes_out, bytes_in, s])
+        rows for the Prometheus exposition."""
+        with self._lock:
+            return [(str(p), str(o), list(r))
+                    for (p, o), r in sorted(self._m.items(),
+                                            key=lambda kv: str(kv[0]))]
+
+
+def _span_dict(r: tuple) -> dict:
+    tid, sid, parent, name, node, t_wall, dur, peer, nbytes, err = r
+    d = {"t": tid, "s": sid, "p": parent, "name": name, "node": node,
+         "t0": round(t_wall, 6), "d": round(dur, 6)}
+    if peer is not None:
+        d["peer"] = peer
+    if nbytes:
+        d["bytes"] = nbytes
+    if err:
+        d["err"] = err
+    return d
+
+
+class Observability:
+    """One node's observability state: span ring + RPC metric tables +
+    the shared :class:`LatencyRecorder`. Constructed unconditionally by
+    the node runtime; ``ObsConfig(trace_ring=0)`` turns every tracing
+    path into a constant-time no-op while the metric tables stay live.
+    """
+
+    def __init__(self, cfg, node_id: int,
+                 latency: LatencyRecorder | None = None) -> None:
+        self.cfg = cfg
+        self.node_id = node_id
+        self.latency = latency if latency is not None else LatencyRecorder()
+        self._ring: deque | None = deque(maxlen=cfg.trace_ring) \
+            if cfg.trace_ring > 0 else None
+        self._lock = threading.Lock()
+        self.rpc_client = RpcStats()
+        self.rpc_server = RpcStats()
+
+    @property
+    def enabled(self) -> bool:
+        return self._ring is not None
+
+    # ---- propagation carriers ---------------------------------------- #
+
+    def wire_trace(self) -> dict | None:
+        """The ``trace`` field to attach to an outbound wire header —
+        {"t","s","f"} naming the CURRENT span as the peer's parent —
+        or None (tracing off / caller untraced): the field is simply
+        omitted, which is also what a pre-r09 node sends."""
+        cur = _ctx.get() if self._ring is not None else None
+        if cur is None:
+            return None
+        return {"t": cur[0], "s": cur[1], "f": self.node_id}
+
+    # ---- span recording ---------------------------------------------- #
+
+    @staticmethod
+    def _annotate(name):
+        """When a jax.profiler device trace is being captured
+        (utils.trace.device_trace set the flag), annotate it like the
+        pre-r09 utils.trace.span did — device timelines keep lining up
+        with framework phases. Returns the entered annotation or None."""
+        if not _trace_mod._PROFILING:
+            return None
+        import jax.profiler  # device_trace already imported it
+
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
+        return ann
+
+    def _traced(self, name, tid, sid, parent, peer, latency_name):
+        tok = _ctx.set((tid, sid))
+        ann = self._annotate(name)
+        sp = Span()
+        t_wall = time.time()
+        t0 = time.perf_counter()
+        err = None
+        try:
+            yield sp
+        except BaseException as e:
+            err = type(e).__name__
+            raise
+        finally:
+            _ctx.reset(tok)
+            dur = time.perf_counter() - t0
+            if latency_name is not None:
+                self.latency.record(latency_name, dur)
+            ring = self._ring
+            if ring is not None:
+                with self._lock:
+                    ring.append((tid, sid, parent, name, self.node_id,
+                                 t_wall, dur, peer, sp.bytes,
+                                 err or sp.err))
+            if ann is not None:
+                with contextlib.suppress(Exception):
+                    ann.__exit__(None, None, None)
+
+    @contextlib.contextmanager
+    def span(self, name: str, peer=None, latency: bool = False):
+        """Child span of the current context. Without an active context
+        (or with tracing off) this is a no-op — except that
+        ``latency=True`` still records the duration into the shared
+        LatencyRecorder under ``name`` (the pre-r09 ``/metrics`` latency
+        surface keeps its keys regardless of tracing state)."""
+        cur = _ctx.get() if self._ring is not None else None
+        if cur is None:
+            if not latency:
+                yield _NULL_SPAN
+                return
+            ann = self._annotate(name)
+            t0 = time.perf_counter()
+            try:
+                yield _NULL_SPAN
+            finally:
+                self.latency.record(name, time.perf_counter() - t0)
+                if ann is not None:
+                    with contextlib.suppress(Exception):
+                        ann.__exit__(None, None, None)
+            return
+        yield from self._traced(name, cur[0], new_span_id(), cur[1],
+                                peer, name if latency else None)
+
+    @contextlib.contextmanager
+    def request_span(self, name: str,
+                     incoming: tuple[str, str] | None = None, peer=None):
+        """Entry-point span (HTTP layer): adopts (trace_id, parent) from
+        an inbound ``X-Dfs-Trace`` carrier, or roots a fresh trace —
+        always-on tracing means every request is traceable, not only the
+        ones a client asked about."""
+        if self._ring is None:
+            yield _NULL_SPAN
+            return
+        if incoming is not None:
+            tid, parent = incoming
+        else:
+            tid, parent = new_trace_id(), None
+        yield from self._traced(name, tid, new_span_id(), parent, peer,
+                                None)
+
+    @contextlib.contextmanager
+    def server_span(self, name: str,
+                    incoming: tuple[str, str, int | None] | None,
+                    peer=None):
+        """Storage-plane server span: ``incoming`` is
+        :func:`parse_wire_trace` output. A frame without a trace field
+        (pre-r09 peer, or an untraced caller) roots a fresh trace."""
+        if self._ring is None:
+            yield _NULL_SPAN
+            return
+        if incoming is not None:
+            tid, parent = incoming[0], incoming[1]
+            if peer is None:
+                peer = incoming[2]
+        else:
+            tid, parent = new_trace_id(), None
+        yield from self._traced(name, tid, new_span_id(), parent, peer,
+                                None)
+
+    # ---- query ------------------------------------------------------- #
+
+    def spans_for(self, trace_id: str) -> list[dict]:
+        """Finished spans of one trace still in the ring (oldest first)."""
+        if self._ring is None:
+            return []
+        with self._lock:
+            rows = [r for r in self._ring if r[0] == trace_id]
+        return [_span_dict(r) for r in rows]
+
+    def stats(self) -> dict:
+        """JSON ``/metrics`` ``obs`` section. The ``traceRing`` /
+        ``slowSpanS`` keys mirror the ObsConfig fields (dfslint DFS005
+        checks this mapping)."""
+        return {"traceRing": self.cfg.trace_ring,
+                "slowSpanS": self.cfg.slow_span_s,
+                "spans": len(self._ring) if self._ring is not None else 0,
+                "rpcClient": self.rpc_client.snapshot(),
+                "rpcServer": self.rpc_server.snapshot()}
+
+
+__all__ = ["Observability", "RpcStats", "Span", "current", "is_id",
+           "new_span_id", "new_trace_id", "parse_http_trace",
+           "parse_wire_trace"]

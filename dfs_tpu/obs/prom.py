@@ -1,0 +1,121 @@
+"""Prometheus text exposition (format 0.0.4) for ``GET /metrics?format=prom``.
+
+Flattens every metric registry the node owns into one scrapeable page:
+
+- ``Counters``            -> ``dfs_counter_total{name=…}``
+- ``Stopwatches``         -> ``dfs_stopwatch_seconds_total{name=…}`` and
+                             ``dfs_peak{name=…}`` (gauges) for ``…Peak``
+- ``LatencyRecorder``     -> ``dfs_latency_seconds`` HISTOGRAM series —
+  the real log2 buckets (``_bucket{le=…}`` cumulative counts, ``_sum``,
+  ``_count``), not the precomputed quantiles: Prometheus computes
+  quantiles server-side and can aggregate histograms across nodes,
+  which pre-digested p50/p90/p99 cannot do.
+- ``RpcStats``            -> ``dfs_rpc_{client,server}_*_total{peer=…,op=…}``
+  per-peer per-op calls/errors/retries/bytes/seconds.
+- node gauges             -> ``dfs_under_replicated``, ``dfs_trace_spans``.
+
+Label values are escaped per the exposition format (backslash, quote,
+newline). The JSON ``/metrics`` endpoint is unchanged — this is an
+additive, lossless view over the same registries.
+"""
+
+from __future__ import annotations
+
+from dfs_tpu.utils.trace import BUCKET_BOUNDS
+
+
+def _esc(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt(v: float) -> str:
+    """Float formatting: integral values without the trailing .0 noise,
+    everything else shortest-round-trip repr."""
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def render_node_metrics(node) -> str:
+    """One node's full Prometheus page. ``node`` is the
+    StorageNodeServer (duck-typed: counters / ingest_stalls / latency /
+    obs / under_replicated)."""
+    lines: list[str] = []
+
+    def fam(name: str, mtype: str) -> None:
+        lines.append(f"# TYPE {name} {mtype}")
+
+    counters = node.counters.snapshot()
+    fam("dfs_counter_total", "counter")
+    for k in sorted(counters):
+        lines.append(f'dfs_counter_total{{name="{_esc(k)}"}} {counters[k]}')
+
+    sw = node.ingest_stalls.snapshot()
+    accum = {k: v for k, v in sw.items() if not k.endswith("Peak")}
+    peaks = {k: v for k, v in sw.items() if k.endswith("Peak")}
+    if accum:
+        fam("dfs_stopwatch_seconds_total", "counter")
+        for k in sorted(accum):
+            lines.append(f'dfs_stopwatch_seconds_total'
+                         f'{{name="{_esc(k)}"}} {_fmt(accum[k])}')
+    if peaks:
+        fam("dfs_peak", "gauge")
+        for k in sorted(peaks):
+            lines.append(f'dfs_peak{{name="{_esc(k)}"}} {_fmt(peaks[k])}')
+
+    hists = node.latency.histogram_snapshot()
+    if hists:
+        fam("dfs_latency_seconds", "histogram")
+        for name in sorted(hists):
+            buckets, count, total = hists[name]
+            lbl = f'name="{_esc(name)}"'
+            acc = 0
+            for bound, c in zip(BUCKET_BOUNDS, buckets):
+                acc += c
+                lines.append(f'dfs_latency_seconds_bucket'
+                             f'{{{lbl},le="{repr(bound)}"}} {acc}')
+            # overflow bucket folds into +Inf; its cumulative count must
+            # equal _count by construction
+            acc += buckets[len(BUCKET_BOUNDS)]
+            lines.append(f'dfs_latency_seconds_bucket'
+                         f'{{{lbl},le="+Inf"}} {acc}')
+            lines.append(f'dfs_latency_seconds_sum{{{lbl}}} {_fmt(total)}')
+            lines.append(f'dfs_latency_seconds_count{{{lbl}}} {count}')
+
+    for side, stats in (("client", node.obs.rpc_client),
+                        ("server", node.obs.rpc_server)):
+        rows = stats.rows()
+        if not rows:
+            continue
+        base = f"dfs_rpc_{side}"
+        # one family at a time: the exposition format requires every
+        # sample of a family contiguous under its single # TYPE line
+        # (strict parsers reject interleaved families; Prometheus's
+        # scraper merely tolerates them)
+        for suffix, idx in (("ops_total", 0), ("errors_total", 1),
+                            ("retries_total", 2)):
+            fam(f"{base}_{suffix}", "counter")
+            for peer, op, row in rows:
+                lines.append(f'{base}_{suffix}{{peer="{_esc(peer)}"'
+                             f',op="{_esc(op)}"}} {row[idx]}')
+        fam(f"{base}_seconds_total", "counter")
+        for peer, op, row in rows:
+            lines.append(f'{base}_seconds_total{{peer="{_esc(peer)}"'
+                         f',op="{_esc(op)}"}} {_fmt(row[5])}')
+        fam(f"{base}_bytes_total", "counter")
+        for peer, op, row in rows:
+            lbl = f'peer="{_esc(peer)}",op="{_esc(op)}"'
+            lines.append(f'{base}_bytes_total'
+                         f'{{{lbl},direction="out"}} {row[3]}')
+            lines.append(f'{base}_bytes_total'
+                         f'{{{lbl},direction="in"}} {row[4]}')
+
+    fam("dfs_under_replicated", "gauge")
+    lines.append(f"dfs_under_replicated {len(node.under_replicated)}")
+    obs = node.obs.stats()
+    fam("dfs_trace_spans", "gauge")
+    lines.append(f'dfs_trace_spans {obs["spans"]}')
+    fam("dfs_trace_ring_capacity", "gauge")
+    lines.append(f'dfs_trace_ring_capacity {obs["traceRing"]}')
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,98 @@
+"""Cross-node trace stitching: merge per-node span lists into one tree.
+
+Each node keeps only ITS spans of a trace (bounded ring, dfs_tpu/obs).
+Stitching is post-hoc, Dapper-style: ``GET /trace?traceId=…`` on any
+node gathers every peer's spans for the id (internal ``get_trace`` op)
+and this module assembles the cross-node tree — parent ids link across
+nodes because the client span's id travels in the RPC's ``trace`` field
+and becomes the server span's parent.
+
+Rendering is plain text for the ``trace <id>`` CLI subcommand: a
+slow-request log (spans at or above the threshold, slowest first) above
+the span tree. Spans whose parent is missing (evicted from a ring, or a
+root) surface as top-level nodes rather than vanishing — an incomplete
+trace must degrade to a forest, never to silence.
+"""
+
+from __future__ import annotations
+
+
+def merge_spans(span_lists) -> list[dict]:
+    """Concatenate per-node span lists, dropping duplicates (a span is
+    unique by (node, span_id) — a retried stitch query may see the same
+    ring entry twice)."""
+    seen: set[tuple] = set()
+    out: list[dict] = []
+    for spans in span_lists:
+        for sp in spans or []:
+            key = (sp.get("node"), sp.get("s"))
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(sp)
+    return out
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n}GiB"
+
+
+def _line(sp: dict) -> str:
+    parts = [sp.get("name", "?"), f"node={sp.get('node')}"]
+    if sp.get("peer") is not None:
+        parts.append(f"peer={sp['peer']}")
+    parts.append(f"{sp.get('d', 0.0):.6f}s")
+    if sp.get("bytes"):
+        parts.append(_fmt_bytes(sp["bytes"]))
+    if sp.get("err"):
+        parts.append(f"ERR={sp['err']}")
+    return " ".join(parts)
+
+
+def render_tree(spans: list[dict], slow_s: float = 1.0) -> str:
+    """One printable report per trace: header, slow-span log (>= slow_s,
+    slowest first), then the span tree (children sorted by start time).
+    """
+    if not spans:
+        return "(no spans — trace unknown or evicted from every ring)"
+    tid = spans[0].get("t", "?")
+    by_id = {sp.get("s"): sp for sp in spans}
+    children: dict[str | None, list[dict]] = {}
+    roots: list[dict] = []
+    for sp in spans:
+        parent = sp.get("p")
+        if parent is not None and parent in by_id:
+            children.setdefault(parent, []).append(sp)
+        else:
+            roots.append(sp)   # true root, or parent missing/evicted
+    for lst in children.values():
+        lst.sort(key=lambda s: s.get("t0", 0.0))
+    roots.sort(key=lambda s: s.get("t0", 0.0))
+
+    nodes = sorted({sp.get("node") for sp in spans})
+    t0 = min(sp.get("t0", 0.0) for sp in spans)
+    t1 = max(sp.get("t0", 0.0) + sp.get("d", 0.0) for sp in spans)
+    out = [f"trace {tid} — {len(spans)} spans, {len(nodes)} node(s) "
+           f"{nodes}, {t1 - t0:.6f}s"]
+
+    slow = sorted((sp for sp in spans if sp.get("d", 0.0) >= slow_s),
+                  key=lambda s: -s.get("d", 0.0))
+    if slow:
+        out.append(f"slow spans (>= {slow_s:g}s):")
+        out.extend(f"  ! {_line(sp)}" for sp in slow)
+
+    def walk(sp: dict, prefix: str, last: bool) -> None:
+        branch = "└─ " if last else "├─ "
+        out.append(prefix + branch + _line(sp))
+        kids = children.get(sp.get("s"), [])
+        ext = "   " if last else "│  "
+        for i, kid in enumerate(kids):
+            walk(kid, prefix + ext, i == len(kids) - 1)
+
+    for i, root in enumerate(roots):
+        walk(root, "", i == len(roots) - 1)
+    return "\n".join(out)

@@ -41,12 +41,14 @@ class ServingTier:
     gates. Constructed unconditionally by the node runtime — the
     default-off config makes every component a no-op."""
 
-    def __init__(self, cfg) -> None:
+    def __init__(self, cfg, obs=None) -> None:
         self.cfg = cfg
         self.cache = ChunkCache(cfg.cache_bytes) \
             if cfg.cache_bytes > 0 else None
         self.flight = SingleFlight()
-        self.admission = AdmissionControl(cfg)
+        # obs threads into the admission gates only (queue-wait spans);
+        # cache/flight are traced at their call sites in the runtime
+        self.admission = AdmissionControl(cfg, obs=obs)
         self.readahead_batches = int(cfg.readahead_batches)
 
     @property

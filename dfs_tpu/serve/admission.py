@@ -38,11 +38,17 @@ class AdmissionGate:
     to ``queue_depth`` waiters, shed beyond that."""
 
     def __init__(self, name: str, slots: int, queue_depth: int,
-                 retry_after_s: float = 1.0) -> None:
+                 retry_after_s: float = 1.0, obs=None) -> None:
         self.name = name
         self.slots = int(slots)
         self.queue_depth = max(0, int(queue_depth))
         self.retry_after_s = float(retry_after_s)
+        # observability hook: a QUEUED acquire records an
+        # `admission.<class>.wait` span under the caller's trace, so a
+        # request's time-in-queue is attributable post-hoc (the fast
+        # path records nothing — admission with a free slot is not
+        # latency)
+        self._obs = obs
         self._active = 0
         self._queue: collections.deque[asyncio.Future] = collections.deque()
         self.admitted = 0
@@ -70,7 +76,11 @@ class AdmissionGate:
         self._queue.append(fut)
         self.queued += 1
         try:
-            await fut
+            if self._obs is not None:
+                with self._obs.span(f"admission.{self.name}.wait"):
+                    await fut
+            else:
+                await fut
         except asyncio.CancelledError:
             if fut.done() and not fut.cancelled():
                 # the grant raced our cancellation: the slot was already
@@ -111,15 +121,16 @@ class AdmissionGate:
 class AdmissionControl:
     """The node's three gates, built from a ServeConfig."""
 
-    def __init__(self, cfg) -> None:
+    def __init__(self, cfg, obs=None) -> None:
         self.download = AdmissionGate(
             "download", cfg.download_slots, cfg.queue_depth,
-            cfg.retry_after_s)
+            cfg.retry_after_s, obs=obs)
         self.upload = AdmissionGate(
-            "upload", cfg.upload_slots, cfg.queue_depth, cfg.retry_after_s)
+            "upload", cfg.upload_slots, cfg.queue_depth, cfg.retry_after_s,
+            obs=obs)
         self.internal = AdmissionGate(
             "internal", cfg.internal_slots, cfg.queue_depth,
-            cfg.retry_after_s)
+            cfg.retry_after_s, obs=obs)
 
     def stats(self) -> dict:
         return {g.name: g.stats()

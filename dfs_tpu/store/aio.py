@@ -53,8 +53,14 @@ class AsyncChunkStore:
       never queues behind either batch lane.
     """
 
-    def __init__(self, store: ChunkStore, workers: int = 4) -> None:
+    def __init__(self, store: ChunkStore, workers: int = 4,
+                 obs=None) -> None:
         self.store = store
+        # Observability hook: when set, each op records a `cas.<op>`
+        # span under the caller's trace context (the await happens on
+        # the event-loop side, so ContextVar inheritance is free even
+        # though run_in_executor itself does not copy contexts).
+        self._obs = obs
         self._workers = max(1, int(workers))
         self._wpool = ThreadPoolExecutor(
             max_workers=self._workers, thread_name_prefix="cas-w")
@@ -69,7 +75,7 @@ class AsyncChunkStore:
         self._busy_s = 0.0
 
     async def _run(self, pool: ThreadPoolExecutor,
-                   fn: Callable[[], T]) -> T:
+                   fn: Callable[[], T], opname: str | None = None) -> T:
         import asyncio
 
         t_submit = time.perf_counter()
@@ -85,17 +91,21 @@ class AsyncChunkStore:
                     self._queue_s += t_start - t_submit
                     self._busy_s += t_end - t_start
 
-        return await asyncio.get_running_loop().run_in_executor(pool, job)
+        loop = asyncio.get_running_loop()
+        if self._obs is None or opname is None:
+            return await loop.run_in_executor(pool, job)
+        with self._obs.span(opname):
+            return await loop.run_in_executor(pool, job)
 
     async def get(self, digest: str) -> bytes | None:
         return await self._run(self._gpool,
-                               lambda: self.store.get(digest))
+                               lambda: self.store.get(digest), "cas.get")
 
     async def put(self, digest: str, data: bytes,
                   verify: bool = False) -> bool:
         return await self._run(
             self._wpool,
-            lambda: self.store.put(digest, data, verify=verify))
+            lambda: self.store.put(digest, data, verify=verify), "cas.put")
 
     async def get_many(self, digests: Sequence[str]
                        ) -> list[tuple[str, bytes]]:
@@ -107,7 +117,8 @@ class AsyncChunkStore:
         return await self._run(
             self._rpool,
             lambda: [(d, b) for d in ds
-                     if (b := self.store.get(d)) is not None])
+                     if (b := self.store.get(d)) is not None],
+            "cas.get_many")
 
     async def put_many(self, items: Sequence[tuple[str, bytes]],
                        verify: bool = False) -> list[bool]:
@@ -118,7 +129,8 @@ class AsyncChunkStore:
         its = list(items)
         return await self._run(
             self._wpool,
-            lambda: [self.store.put(d, b, verify=verify) for d, b in its])
+            lambda: [self.store.put(d, b, verify=verify) for d, b in its],
+            "cas.put_many")
 
     def stats(self) -> dict:
         with self._lock:

@@ -14,6 +14,23 @@ import threading
 from collections import defaultdict
 
 
+def capped_key(table: dict, key, cap: int, owner, what: str, fold):
+    """Shared cardinality guard for metric registries: returns ``key``
+    while it is already present or the registry has room, else the
+    registry's ``fold`` key (warning ONCE via ``owner._overflow_warned``).
+    One implementation on purpose — Counters, LatencyRecorder and
+    RpcStats all need the identical cap/log/fold discipline, and three
+    hand-rolled copies would drift."""
+    if key in table or len(table) < cap:
+        return key
+    if not owner._overflow_warned:
+        owner._overflow_warned = True
+        logging.getLogger("dfs_tpu.metrics").warning(
+            "%s cardinality cap (%d) hit; folding new keys into %r",
+            what, cap, fold)
+    return fold
+
+
 def get_logger(name: str, node_id: int | None = None) -> logging.Logger:
     suffix = f".node{node_id}" if node_id is not None else ""
     logger = logging.getLogger(f"dfs_tpu.{name}{suffix}")
@@ -29,14 +46,24 @@ def get_logger(name: str, node_id: int | None = None) -> logging.Logger:
 
 
 class Counters:
-    """Thread-safe monotonic counters; one instance per node runtime."""
+    """Thread-safe monotonic counters; one instance per node runtime.
+
+    Name cardinality is capped: beyond ``_MAX_NAMES`` distinct names,
+    new ones fold into a single ``_overflow`` key (logged once) — a
+    code path that derives counter names from peer input or digests can
+    degrade ``/metrics`` readability but never its boundedness."""
+
+    _MAX_NAMES = 512
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._c: dict[str, int] = defaultdict(int)
+        self._overflow_warned = False
 
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
+            name = capped_key(self._c, name, self._MAX_NAMES, self,
+                              "Counters", "_overflow")
             self._c[name] += by
 
     def snapshot(self) -> dict[str, int]:

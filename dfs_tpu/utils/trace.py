@@ -19,26 +19,47 @@ import math
 import threading
 import time
 
-# bucket upper bounds in seconds: 1us .. ~134s, powers of two
+from dfs_tpu.utils.logging import capped_key
+
+# bucket upper bounds in seconds: 1us .. ~134s, powers of two. Bucket i
+# covers (_BOUNDS[i-1], _BOUNDS[i]]; one overflow bucket sits past the
+# last bound. Exported (read-only by convention) for the Prometheus
+# exposition, which emits the raw buckets rather than quantiles.
 _BOUNDS = [2.0 ** e for e in range(-20, 8)]
+BUCKET_BOUNDS = tuple(_BOUNDS)
 
 
 class LatencyRecorder:
+    # distinct metric names this registry will hold; further names fold
+    # into "_overflow" (logged once) so peer-derived or per-digest names
+    # can never grow /metrics unboundedly
+    _MAX_NAMES = 512
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._hist: dict[str, list[int]] = {}
         self._stats: dict[str, tuple[int, float, float]] = {}  # n, sum, max
+        self._overflow_warned = False
 
     def record(self, name: str, seconds: float) -> None:
         idx = bisect.bisect_left(_BOUNDS, seconds)
         with self._lock:
+            name = capped_key(self._hist, name, self._MAX_NAMES, self,
+                              "LatencyRecorder", "_overflow")
             h = self._hist.setdefault(name, [0] * (len(_BOUNDS) + 1))
             h[min(idx, len(_BOUNDS))] += 1
             n, s, mx = self._stats.get(name, (0, 0.0, 0.0))
             self._stats[name] = (n + 1, s + seconds, max(mx, seconds))
 
-    def _quantile(self, h: list[int], q: float) -> float:
-        total = sum(h)
+    def _quantile(self, h: list[int], q: float, total: int) -> float:
+        """Bucket-estimated quantile: the GEOMETRIC MIDPOINT of the
+        bucket the q-th sample falls in. Returning the bucket's upper
+        bound (the behavior until round 9) over-reported every quantile
+        by up to 2x — a sample of 10 µs sat in the (7.6, 15.3] µs bucket
+        and reported as 15.3. sqrt(lo*hi) is the unbiased point estimate
+        under the log2 layout (error <= sqrt(2) either way). ``total``
+        is the recorded count — computed ONCE per name by the caller,
+        not per quantile."""
         if total == 0:
             return 0.0
         target = math.ceil(q * total)
@@ -46,23 +67,37 @@ class LatencyRecorder:
         for i, c in enumerate(h):
             seen += c
             if seen >= target:
-                return _BOUNDS[min(i, len(_BOUNDS) - 1)]
-        return _BOUNDS[-1]
+                if i >= len(_BOUNDS):    # overflow bucket: no upper edge
+                    return _BOUNDS[-1] * math.sqrt(2.0)
+                lo = _BOUNDS[i - 1] if i > 0 else _BOUNDS[0] / 2.0
+                return math.sqrt(lo * _BOUNDS[i])
+        return _BOUNDS[-1] * math.sqrt(2.0)
 
     def snapshot(self) -> dict[str, dict[str, float]]:
         with self._lock:
             out = {}
             for name, h in self._hist.items():
                 n, s, mx = self._stats[name]
+                # n == sum(h) by construction (both bumped under the
+                # lock); the observed max clamps the top-bucket estimate
                 out[name] = {
                     "count": n,
                     "mean_s": round(s / n, 6) if n else 0.0,
-                    "p50_s": round(self._quantile(h, 0.50), 6),
-                    "p90_s": round(self._quantile(h, 0.90), 6),
-                    "p99_s": round(self._quantile(h, 0.99), 6),
+                    "p50_s": round(min(self._quantile(h, 0.50, n), mx), 6),
+                    "p90_s": round(min(self._quantile(h, 0.90, n), mx), 6),
+                    "p99_s": round(min(self._quantile(h, 0.99, n), mx), 6),
                     "max_s": round(mx, 6),
                 }
             return out
+
+    def histogram_snapshot(self) -> dict[str, tuple[list[int], int, float]]:
+        """name -> (bucket counts aligned to BUCKET_BOUNDS plus one
+        overflow slot, total count, sum of seconds) — the raw material
+        for Prometheus histogram exposition."""
+        with self._lock:
+            return {name: (list(h), self._stats[name][0],
+                           self._stats[name][1])
+                    for name, h in self._hist.items()}
 
 
 # Set only while device_trace() is active. span() consults this flag instead

@@ -31,6 +31,12 @@ from pathlib import Path
 CITATION = re.compile(r"\b([A-Za-z0-9]\w*_r[0-9]{2}[a-z]?\.json)\b")
 CODE_SUFFIXES = (".py", ".cpp", ".h")
 
+# Artifacts an acceptance gate names directly: these must exist even if
+# no committed code happens to cite them. Only enforced when linting
+# THIS repo (detected by this script's own path) — fabricated test
+# repos are exempt.
+REQUIRED_ARTIFACTS = ("OBS_r09.json",)
+
 
 def _tracked_files(root: Path) -> list[Path]:
     """git-tracked files (committed code is the contract), falling back
@@ -63,6 +69,11 @@ def check(root: Path | str = ".") -> list[str]:
                 if not (root / name).is_file():
                     problems.append(
                         f"{path.relative_to(root)}:{lineno}: {name}")
+    if (root / "scripts" / "check_artifacts.py").is_file():
+        for name in REQUIRED_ARTIFACTS:
+            if not (root / name).is_file():
+                problems.append(
+                    f"scripts/check_artifacts.py:REQUIRED: {name}")
     return problems
 
 

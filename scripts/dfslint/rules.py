@@ -275,7 +275,7 @@ def check_digest_boundary(project: Project) -> Iterator[Finding]:
 # dataclasses in dfs_tpu/config.py whose every field must be settable
 # from the `serve` CLI (a field without a flag silently pins a
 # deployment to the default — the drift this rule exists to catch)
-_CLI_CLASSES = ("NodeConfig", "ServeConfig", "IngestConfig")
+_CLI_CLASSES = ("NodeConfig", "ServeConfig", "IngestConfig", "ObsConfig")
 # config field -> /metrics key that surfaces it, per stats function.
 # "cas" carries cas_io_threads as its nested workers count
 # (store/aio.py stats()).
@@ -292,6 +292,10 @@ _SERVE_METRIC_KEYS = {"cache_bytes": "cache",
                       "internal_slots": "admission",
                       "queue_depth": "admission",
                       "retry_after_s": "admission"}
+# observability knobs surface under /metrics "obs"
+# (dfs_tpu/obs/__init__.py Observability.stats())
+_OBS_METRIC_KEYS = {"trace_ring": "traceRing",
+                    "slow_span_s": "slowSpanS"}
 
 
 def _dataclass_fields(src: SourceFile) -> dict[str, dict[str, int]]:
@@ -393,6 +397,7 @@ def check_config_drift(project: Project) -> Iterator[Finding]:
     cli = project.find("dfs_tpu/cli/main.py")
     runtime = project.find("dfs_tpu/node/runtime.py")
     serve_pkg = project.find("dfs_tpu/serve/__init__.py")
+    obs_pkg = project.find("dfs_tpu/obs/__init__.py")
     classes = _dataclass_fields(cfg) if cfg and cfg.tree else {}
 
     # (1) every config field is wired through the serve CLI's
@@ -443,7 +448,8 @@ def check_config_drift(project: Project) -> Iterator[Finding]:
     # knob cannot ship observably-invisible
     for src, func, cls, table in (
             (runtime, "ingest_stats", "IngestConfig", _INGEST_METRIC_KEYS),
-            (serve_pkg, "stats", "ServeConfig", _SERVE_METRIC_KEYS)):
+            (serve_pkg, "stats", "ServeConfig", _SERVE_METRIC_KEYS),
+            (obs_pkg, "stats", "ObsConfig", _OBS_METRIC_KEYS)):
         if src is None or src.tree is None or cls not in classes:
             continue
         keys = _stats_dict_keys(src, func)

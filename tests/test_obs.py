@@ -1,0 +1,517 @@
+"""Observability tests (dfs_tpu/obs): trace-context propagation across
+the peer wire, cluster trace stitching, Prometheus exposition, and the
+pre-r09 compatibility guarantees (optional wire field, JSON /metrics
+superset).
+
+Cluster scaffolding mirrors test_node_cluster: real asyncio node pairs
+on localhost ports, CPU CDC engine, and NO sleeps — every assertion
+rides on awaited completions."""
+
+import asyncio
+import json
+import re
+import socket
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dfs_tpu.comm.wire import read_msg, send_msg
+from dfs_tpu.config import (CDCParams, ClusterConfig, NodeConfig,
+                            ObsConfig, PeerAddr)
+from dfs_tpu.node.runtime import StorageNodeServer
+from dfs_tpu.obs import (Observability, RpcStats, new_span_id,
+                         new_trace_id, parse_http_trace, parse_wire_trace)
+from dfs_tpu.obs.stitch import merge_spans, render_tree
+from dfs_tpu.serve.admission import AdmissionGate
+
+CDC = CDCParams(min_size=64, avg_size=256, max_size=1024)
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def make_cluster_cfg(n: int, rf: int = 2) -> ClusterConfig:
+    ports = _free_ports(2 * n)
+    peers = tuple(
+        PeerAddr(node_id=i + 1, host="127.0.0.1",
+                 port=ports[2 * i], internal_port=ports[2 * i + 1])
+        for i in range(n))
+    return ClusterConfig(peers=peers, replication_factor=rf)
+
+
+async def start_nodes(cluster, root: Path, **cfg_kw):
+    nodes = {}
+    cfg_kw.setdefault("cdc", CDC)
+    cfg_kw.setdefault("health_probe_s", 0)
+    for p in cluster.peers:
+        cfg = NodeConfig(node_id=p.node_id, cluster=cluster,
+                         data_root=root, fragmenter="cdc", **cfg_kw)
+        node = StorageNodeServer(cfg)
+        await node.start()
+        nodes[p.node_id] = node
+    return nodes
+
+
+async def stop_nodes(nodes) -> None:
+    for n in nodes.values():
+        await n.stop()
+
+
+def _req(port: int, method: str, path: str, body=None, headers=None):
+    r = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                               data=body, method=method,
+                               headers=headers or {})
+    with urllib.request.urlopen(r, timeout=60) as resp:
+        return resp.read()
+
+
+# --------------------------------------------------------------------- #
+# a minimal Prometheus text-format (0.0.4) parser — the in-repo checker
+# the prom endpoint is validated against
+# --------------------------------------------------------------------- #
+
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prom(text: str):
+    """-> (samples, types): samples maps (metric name, sorted label
+    tuple) -> float; types maps family -> declared type. Raises
+    AssertionError on any malformed line, on a family declared twice,
+    or on a family whose samples are not CONTIGUOUS (the exposition
+    format's grouping rule — strict parsers reject interleaving)."""
+    samples, types = {}, {}
+    done_families, cur_family = set(), None
+
+    def family(name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in types:
+                return name[:-len(suffix)]
+        return name
+
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            assert len(parts) >= 4 and parts[1] in ("TYPE", "HELP"), line
+            if parts[1] == "TYPE":
+                assert parts[2] not in types, \
+                    f"family {parts[2]} declared twice"
+                types[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE.match(line)
+        assert m, f"malformed prom sample line: {line!r}"
+        name, labels, value = m.groups()
+        fam = family(name)
+        if fam != cur_family:
+            assert fam not in done_families, \
+                f"family {fam} samples not contiguous"
+            if cur_family is not None:
+                done_families.add(cur_family)
+            cur_family = fam
+        lbl = tuple(sorted(_LABEL.findall(labels))) if labels else ()
+        if labels:
+            # the label block must be FULLY consumed by well-formed pairs
+            stripped = _LABEL.sub("", labels).replace(",", "")
+            assert stripped == "", f"bad labels in {line!r}"
+        v = float("inf") if value == "+Inf" else float(value)
+        key = (name, lbl)
+        assert key not in samples, f"duplicate sample {key}"
+        samples[key] = v
+    return samples, types
+
+
+# --------------------------------------------------------------------- #
+# unit: ids, carriers, span nesting, ring bounds
+# --------------------------------------------------------------------- #
+
+def test_parse_http_trace():
+    tid, sid = new_trace_id(), new_span_id()
+    assert parse_http_trace(f"{tid}-{sid}") == (tid, sid)
+    assert parse_http_trace(None) is None
+    assert parse_http_trace("") is None
+    assert parse_http_trace("nonsense") is None
+    assert parse_http_trace(f"{tid}-short") is None
+    assert parse_http_trace(f"{tid[:-1]}g-{sid}") is None  # non-hex
+
+
+def test_is_id_rejects_int_parse_lookalikes():
+    """int(s, 16) accepts '0x'/sign/underscore/uppercase forms — the
+    strict charset must not (ids are canonical lowercase hex)."""
+    from dfs_tpu.obs import TRACE_HEX, is_id
+
+    good = new_trace_id()
+    assert is_id(good, TRACE_HEX)
+    for bad in ("0x" + good[2:], "+" + good[1:], "-" + good[1:],
+                good[:-2] + "_a", good.upper(), " " + good[1:]):
+        assert len(bad) == TRACE_HEX
+        assert not is_id(bad, TRACE_HEX), bad
+
+
+def test_parse_wire_trace():
+    tid, sid = new_trace_id(), new_span_id()
+    assert parse_wire_trace({"t": tid, "s": sid, "f": 3}) == (tid, sid, 3)
+    assert parse_wire_trace({"t": tid, "s": sid}) == (tid, sid, None)
+    # malformed shapes degrade to None, never raise (old/hostile peers)
+    for bad in (None, "x", 7, [], {"t": tid}, {"t": 1, "s": 2},
+                {"t": tid, "s": sid, "f": True}):
+        got = parse_wire_trace(bad)
+        assert got is None or got[2] is None
+
+
+def test_span_nesting_records_parent_chain():
+    obs = Observability(ObsConfig(trace_ring=64), node_id=7)
+
+    async def run():
+        with obs.request_span("http./x") as root:
+            assert root is not None
+            with obs.span("inner", peer=2) as sp:
+                sp.bytes = 123
+
+    asyncio.run(run())
+    # both spans share one trace; inner's parent is the request span
+    ring = obs._ring
+    assert len(ring) == 2
+    inner, outer = ring[0], ring[1]   # inner finishes first
+    assert inner[0] == outer[0]               # same trace id
+    assert inner[2] == outer[1]               # parent linkage
+    assert outer[2] is None                   # fresh root
+    spans = obs.spans_for(inner[0])
+    assert {s["name"] for s in spans} == {"http./x", "inner"}
+    assert next(s for s in spans if s["name"] == "inner")["bytes"] == 123
+
+
+def test_tracing_off_is_noop_but_latency_survives():
+    obs = Observability(ObsConfig(trace_ring=0), node_id=1)
+    with obs.request_span("http./x"):
+        with obs.span("phase", latency=True):
+            pass
+        assert obs.wire_trace() is None
+    assert obs.spans_for("0" * 32) == []
+    assert "phase" in obs.latency.snapshot()   # metrics stay on
+    assert obs.stats()["traceRing"] == 0
+
+
+def test_span_error_annotation():
+    obs = Observability(ObsConfig(trace_ring=8), node_id=1)
+    with pytest.raises(ValueError):
+        with obs.request_span("http./x"):
+            with obs.span("boom"):
+                raise ValueError("nope")
+    tid = obs._ring[0][0]
+    spans = obs.spans_for(tid)
+    assert next(s for s in spans if s["name"] == "boom")["err"] \
+        == "ValueError"
+
+
+def test_ring_is_bounded():
+    obs = Observability(ObsConfig(trace_ring=4), node_id=1)
+    for _ in range(10):
+        with obs.request_span("http./x"):
+            pass
+    assert len(obs._ring) == 4
+
+
+def test_rpcstats_cardinality_cap():
+    st = RpcStats()
+    for i in range(RpcStats._MAX_KEYS + 50):
+        st.record(i, "op", 0.001)
+    snap = st.snapshot()
+    assert len(snap) <= RpcStats._MAX_KEYS + 1
+    assert snap["_overflow:_overflow"]["count"] == 50
+
+
+def test_admission_queue_wait_records_span():
+    obs = Observability(ObsConfig(trace_ring=32), node_id=1)
+    gate = AdmissionGate("download", slots=1, queue_depth=4, obs=obs)
+
+    async def run():
+        await gate.acquire()          # takes the slot
+
+        async def queued():
+            with obs.request_span("http./download"):
+                await gate.acquire()
+            gate.release()
+
+        t = asyncio.create_task(queued())
+        while not gate._queue:        # deterministic: just yield until
+            await asyncio.sleep(0)    # the waiter parked (no timed sleep)
+        gate.release()                # slot transfers to the waiter
+        await t
+
+    asyncio.run(run())
+    names = [r[3] for r in obs._ring]
+    assert "admission.download.wait" in names
+
+
+# --------------------------------------------------------------------- #
+# stitcher
+# --------------------------------------------------------------------- #
+
+def test_merge_spans_dedups():
+    a = {"node": 1, "s": "aa", "t": "t", "name": "x", "t0": 0.0, "d": 1.0}
+    b = {"node": 2, "s": "aa", "t": "t", "name": "y", "t0": 0.0, "d": 1.0}
+    assert len(merge_spans([[a], [a, b]])) == 2
+
+
+def test_render_tree_structure_and_slow_log():
+    tid = "f" * 32
+    spans = [
+        {"t": tid, "s": "a" * 16, "p": None, "name": "http./download",
+         "node": 1, "t0": 0.0, "d": 2.5},
+        {"t": tid, "s": "b" * 16, "p": "a" * 16, "name": "rpc.get_chunks",
+         "node": 1, "peer": 2, "t0": 0.1, "d": 0.2, "bytes": 2048},
+        {"t": tid, "s": "c" * 16, "p": "b" * 16, "name": "peer.get_chunks",
+         "node": 2, "t0": 0.15, "d": 0.1},
+        # orphan (parent evicted): must surface as a top-level node
+        {"t": tid, "s": "d" * 16, "p": "e" * 16, "name": "cas.get",
+         "node": 3, "t0": 0.2, "d": 0.05},
+    ]
+    out = render_tree(spans, slow_s=1.0)
+    assert "slow spans (>= 1s):" in out
+    assert out.count("http./download") == 2     # slow log + tree
+    # the child nests under its parent, cross-node
+    tree_lines = out.splitlines()
+    rpc_line = next(ln for ln in tree_lines if "rpc.get_chunks" in ln)
+    peer_line = next(ln for ln in tree_lines if "peer.get_chunks" in ln)
+    assert len(peer_line) - len(peer_line.lstrip("│ ├└─")) >= 0
+    assert tree_lines.index(peer_line) == tree_lines.index(rpc_line) + 1
+    assert "cas.get" in out                     # orphan not silenced
+    assert "2.0KiB" in out
+    assert render_tree([], 1.0).startswith("(no spans")
+
+
+# --------------------------------------------------------------------- #
+# cluster: stitched cross-node trace (the acceptance scenario)
+# --------------------------------------------------------------------- #
+
+def test_cluster_stitched_trace(tmp_path, rng):
+    """3-node upload+download tagged with one client trace id: the
+    cluster stitch must return a single trace whose parent ids link
+    client-facing HTTP spans to the peer RPC spans they caused, across
+    node boundaries."""
+    data = rng.integers(0, 256, size=60_000, dtype=np.uint8).tobytes()
+    tid = new_trace_id()
+    hdr = {"X-Dfs-Trace": f"{tid}-{new_span_id()}"}
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            p = cluster.peers
+            up = json.loads(await asyncio.to_thread(
+                _req, p[0].port, "POST", "/upload?name=t.bin", data, hdr))
+            got = await asyncio.to_thread(
+                _req, p[2].port, "GET",
+                f"/download?fileId={up['fileId']}", None, hdr)
+            assert got == data
+            return json.loads((await asyncio.to_thread(
+                _req, p[0].port, "GET",
+                f"/trace?traceId={tid}")).decode())
+        finally:
+            await stop_nodes(nodes)
+
+    trace = asyncio.run(run())
+    spans = trace["spans"]
+    assert all(s["t"] == tid for s in spans)
+    by_id = {s["s"]: s for s in spans}
+    nodes_seen = {s["node"] for s in spans}
+    assert len(nodes_seen) >= 2
+    names = {s["name"] for s in spans}
+    # client-facing HTTP spans on the nodes the client actually hit
+    up_span = next(s for s in spans if s["name"] == "http./upload")
+    down_span = next(s for s in spans if s["name"] == "http./download")
+    assert up_span["node"] == 1 and down_span["node"] == 3
+    # the HTTP spans CAUSED rpc spans: rpc.* parents chain up to them
+    def chains_to(span, ancestor_id):
+        while span is not None:
+            if span["s"] == ancestor_id:
+                return True
+            span = by_id.get(span["p"])
+        return False
+
+    rpc_from_upload = [s for s in spans if s["name"].startswith("rpc.")
+                       and chains_to(s, up_span["s"])]
+    assert rpc_from_upload, "upload produced no rpc spans"
+    # cross-node parent links: a peer.* span whose parent span lives on
+    # a DIFFERENT node (the rpc client span that caused it)
+    cross = [s for s in spans
+             if s.get("p") in by_id
+             and by_id[s["p"]]["node"] != s["node"]]
+    assert cross, "no cross-node parent links"
+    assert any(s["name"].startswith("peer.") for s in cross)
+    # context propagated through create_task + the CAS executor awaits
+    assert any(n.startswith("cas.") for n in names)
+    # the stitcher renders it as ONE tree (single header line, every
+    # span present)
+    rendered = render_tree(spans, slow_s=trace["slowSpanS"])
+    assert rendered.splitlines()[0].startswith(f"trace {tid}")
+    assert "http./upload" in rendered and "http./download" in rendered
+    assert "peer.store_chunks" in rendered
+
+
+def test_trace_endpoint_validates_id(tmp_path):
+    async def run():
+        cluster = make_cluster_cfg(1, rf=1)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            port = cluster.peers[0].port
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                await asyncio.to_thread(
+                    _req, port, "GET", "/trace?traceId=nothex")
+            assert ei.value.code == 400
+            ei.value.read()
+            # valid-but-unknown id: empty span list, not an error
+            out = json.loads((await asyncio.to_thread(
+                _req, port, "GET",
+                f"/trace?traceId={'0' * 32}&cluster=0")).decode())
+            assert out["spans"] == []
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+# --------------------------------------------------------------------- #
+# Prometheus exposition + JSON backward compatibility
+# --------------------------------------------------------------------- #
+
+# top-level JSON /metrics keys of the r08 schema — the default output
+# must remain a superset (pre-r09 scrapers keep working untouched)
+R08_METRICS_KEYS = {"nodeId", "underReplicated", "latency", "peersAlive",
+                    "serve", "ingest"}
+
+
+def test_prom_exposition_and_json_superset(tmp_path, rng):
+    data = rng.integers(0, 256, size=40_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            p = cluster.peers
+            up = json.loads(await asyncio.to_thread(
+                _req, p[0].port, "POST", "/upload?name=m.bin", data))
+            await asyncio.to_thread(
+                _req, p[0].port, "GET", f"/download?fileId={up['fileId']}")
+            prom = (await asyncio.to_thread(
+                _req, p[0].port, "GET", "/metrics?format=prom")).decode()
+            # server-side RPC series live on the RECEIVING nodes
+            prom2 = (await asyncio.to_thread(
+                _req, p[1].port, "GET", "/metrics?format=prom")).decode()
+            js = json.loads((await asyncio.to_thread(
+                _req, p[0].port, "GET", "/metrics")).decode())
+            return prom, prom2, js
+        finally:
+            await stop_nodes(nodes)
+
+    prom, prom2, js = asyncio.run(run())
+    samples, types = parse_prom(prom)
+    samples2, _ = parse_prom(prom2)
+
+    # counters made it over
+    assert samples[("dfs_counter_total", (("name", "uploads"),))] == 1.0
+    assert types["dfs_counter_total"] == "counter"
+
+    # RPC per-peer per-op client series exist for real peers
+    rpc_ops = {lbls for (name, lbls) in samples
+               if name == "dfs_rpc_client_ops_total"}
+    assert (("op", "store_chunks"), ("peer", "2")) in rpc_ops \
+        or (("op", "store_chunks"), ("peer", "3")) in rpc_ops
+    server_ops = {dict(lbls)["op"] for (name, lbls) in samples2
+                  if name == "dfs_rpc_server_ops_total"}
+    assert "store_chunks" in server_ops or "has_chunks" in server_ops
+
+    # latency histograms: real log2 buckets, cumulative, +Inf == count
+    hist_names = {dict(lbls)["name"]
+                  for (name, lbls) in samples
+                  if name == "dfs_latency_seconds_bucket"}
+    assert "http.request" in hist_names
+    for hname in hist_names:
+        buckets = sorted(
+            (float(dict(lbls)["le"]), v)
+            for (name, lbls), v in samples.items()
+            if name == "dfs_latency_seconds_bucket"
+            and dict(lbls)["name"] == hname)
+        counts = [v for _, v in buckets]
+        assert counts == sorted(counts), f"{hname} buckets not cumulative"
+        count = samples[("dfs_latency_seconds_count",
+                         (("name", hname),))]
+        assert buckets[-1][0] == float("inf")
+        assert buckets[-1][1] == count
+
+    # default JSON output: strict superset of the r08 schema
+    assert R08_METRICS_KEYS <= set(js)
+    assert "obs" in js and js["obs"]["traceRing"] == 2048
+    assert "rpcClient" in js["obs"]
+
+
+# --------------------------------------------------------------------- #
+# pre-r09 wire compatibility
+# --------------------------------------------------------------------- #
+
+def test_old_peer_without_trace_field_interops(tmp_path, rng):
+    """A tracing node must interoperate byte-identically with a peer
+    whose client never sends the wire ``trace`` field (pre-r09 node):
+    upload driven by the OLD-style node, download served by the tracing
+    node, plus raw frames with absent/garbage trace fields."""
+    data = rng.integers(0, 256, size=50_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(2)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            # node 2 becomes the pre-r09 node: its client has no obs
+            # hook, so its frames carry NO trace field — exactly the
+            # old wire format
+            nodes[2].client._obs = None
+            m, _ = await nodes[2].upload(data, "compat.bin")
+            _, got = await nodes[1].download(m.file_id)
+            assert got == data
+
+            # raw frame WITHOUT a trace field against the tracing node
+            addr = cluster.peers[0]
+            reader, writer = await asyncio.open_connection(
+                addr.host, addr.internal_port)
+            try:
+                await send_msg(writer, {"op": "has_chunks",
+                                        "digests": []})
+                resp, _ = await read_msg(reader)
+                assert resp["ok"] is True
+                # garbage trace field: ignored, never an error
+                await send_msg(writer, {"op": "health",
+                                        "trace": "garbage"})
+                resp, _ = await read_msg(reader)
+                assert resp["ok"] is True and resp["nodeId"] == 1
+            finally:
+                writer.close()
+                await writer.wait_closed()
+            ring_names = {r[3] for r in nodes[1].obs._ring}
+            return nodes[1].obs.rpc_server.snapshot(), ring_names
+        finally:
+            await stop_nodes(nodes)
+
+    server_rpc, ring_names = asyncio.run(run())
+    # the tracing node's server table recorded the old peer's calls
+    # under the unknown-sender label
+    assert any(k.startswith("-:") for k in server_rpc)
+    # untraced HEAVY ops still root a trace (diagnosable), but untraced
+    # cheap ops (health/has_chunks probes) must NOT mint ring entries —
+    # probe noise would evict client-tagged spans
+    assert "peer.store_chunks" in ring_names
+    assert "peer.health" not in ring_names
+    assert "peer.has_chunks" not in ring_names

@@ -75,8 +75,8 @@ async def hot_read_phase(node: StorageNodeServer, file_id: str,
     """Aggregate GiB/s of ``readers`` concurrent whole-file range reads
     repeated ``rounds`` times (the HTTP 206 path: per-chunk integrity)."""
     async def read_once() -> None:
-        _, data, _, _ = await node.download_range(file_id, 0, size - 1)
-        assert len(data) == size
+        _, parts, _, _ = await node.download_range(file_id, 0, size - 1)
+        assert sum(len(p) for p in parts) == size
 
     t0 = time.perf_counter()
     for _ in range(rounds):

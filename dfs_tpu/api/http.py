@@ -38,15 +38,20 @@ MAX_BODY = 4 * 1024 * 1024 * 1024
 STREAM_BODY_BYTES = 64 * 1024 * 1024
 
 
-def _resp(status: int, body: bytes, content_type: str,
+def _head(status: int, length: int, content_type: str,
           extra: dict[str, str] | None = None) -> bytes:
     head = [f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}",
             f"Content-Type: {content_type}",
-            f"Content-Length: {len(body)}",
+            f"Content-Length: {length}",
             "Connection: close"]
     for k, v in (extra or {}).items():
         head.append(f"{k}: {v}")
-    return ("\r\n".join(head) + "\r\n\r\n").encode() + body
+    return ("\r\n".join(head) + "\r\n\r\n").encode()
+
+
+def _resp(status: int, body: bytes, content_type: str,
+          extra: dict[str, str] | None = None) -> bytes:
+    return _head(status, len(body), content_type, extra) + body
 
 
 def _bad_id(file_id: str) -> bool:
@@ -65,25 +70,27 @@ def as_json(status: int, obj) -> bytes:
     return _resp(status, json.dumps(obj).encode(), "application/json")
 
 
-def binary(status: int, data: bytes, filename: str) -> bytes:
-    # Content-Disposition download, reference StorageNode.java:460,592-601.
-    # Strip control characters (CR/LF would split the header — injection) and
-    # quotes before interpolating the user-supplied name into a header.
-    safe = "".join(c for c in filename if c >= " " and c != '"') or "download"
-    return _resp(status, data, "application/octet-stream",
-                 {"Content-Disposition": f'attachment; filename="{safe}"'})
+def resp_parts(status: int, parts: list, content_type: str,
+               extra: dict[str, str] | None = None) -> list:
+    """Vectored response: ``[head bytes, *payload buffers]``. The handler
+    writes each element to the socket as-is — payload buffers (read-only
+    chunk views from the store/cache/wire) are never joined into one
+    body (docs/wire.md zero-copy discipline). Content-Length is the
+    buffer-length sum, so the on-wire response is byte-identical to the
+    joined form."""
+    length = sum(len(p) for p in parts)
+    return [_head(status, length, content_type, extra), *parts]
 
 
 def binary_head(status: int, length: int, filename: str) -> bytes:
-    """Response head only (Content-Length known upfront from the
-    manifest) — the body streams behind it chunk by chunk."""
+    """Content-Disposition download head (reference StorageNode.java:460,
+    592-601; Content-Length known upfront from the manifest) — the body
+    streams behind it buffer by buffer. Strip control characters (CR/LF
+    would split the header — injection) and quotes before interpolating
+    the user-supplied name into a header."""
     safe = "".join(c for c in filename if c >= " " and c != '"') or "download"
-    head = [f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}",
-            "Content-Type: application/octet-stream",
-            f"Content-Length: {length}",
-            "Connection: close",
-            f'Content-Disposition: attachment; filename="{safe}"']
-    return ("\r\n".join(head) + "\r\n\r\n").encode()
+    return _head(status, length, "application/octet-stream",
+                 {"Content-Disposition": f'attachment; filename="{safe}"'})
 
 
 def _shed(node: "StorageNodeServer", e) -> bytes:
@@ -194,7 +201,13 @@ def make_http_handler(node: "StorageNodeServer"):
             out = plain(500, f"Internal error: {e}")
         node.latency.record("http.request", time.perf_counter() - t0)
         try:
-            writer.write(out)
+            if isinstance(out, list):
+                # vectored response (resp_parts): head + payload views
+                # written individually — no join anywhere on the way out
+                for part in out:
+                    writer.write(part)
+            else:
+                writer.write(out)
             await writer.drain()
             if body_gen is not None:
                 try:
@@ -288,6 +301,8 @@ async def _serve_one(node: "StorageNodeServer",
                            content_length, range_header, chunked)
         if isinstance(out, (bytes, bytearray)):
             sp.bytes = len(out)
+        elif isinstance(out, list):             # vectored response
+            sp.bytes = sum(len(p) for p in out)
         return out
 
 
@@ -478,13 +493,15 @@ async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
         try:
             if rng is not None:
                 try:
-                    manifest, data, start, end = await node.download_range(
+                    manifest, parts, start, end = await node.download_range(
                         file_id, *rng)
                 except RangeNotSatisfiable as e:
                     return _resp(416, b"", "text/plain",
                                  {"Content-Range": f"bytes */{e.size}"})
-                return _resp(
-                    206, data, "application/octet-stream",
+                # vectored 206: the range's chunk views go to the socket
+                # one by one — never joined into a body (docs/wire.md)
+                return resp_parts(
+                    206, parts, "application/octet-stream",
                     {"Content-Range":
                      f"bytes {start}-{end - 1}/{manifest.size}",
                      "Accept-Ranges": "bytes"})

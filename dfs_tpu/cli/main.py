@@ -17,8 +17,9 @@ import sys
 from pathlib import Path
 
 from dfs_tpu.cli.client import NodeClient
-from dfs_tpu.config import (CDCParams, ClusterConfig, IngestConfig,
-                            NodeConfig, ObsConfig, ServeConfig)
+from dfs_tpu.config import (CDCParams, ClusterConfig, FragmenterConfig,
+                            IngestConfig, NodeConfig, ObsConfig,
+                            ServeConfig)
 
 
 def _client(args) -> NodeClient:
@@ -46,6 +47,8 @@ def cmd_serve(args) -> int:
         sidecar_port=args.sidecar_port,
         cdc=CDCParams(min_size=args.min_chunk, avg_size=args.avg_chunk,
                       max_size=args.max_chunk),
+        frag=FragmenterConfig(devices=args.cdc_devices,
+                              region_bytes=args.cdc_region_bytes),
         fixed_parts=args.fixed_parts,
         connect_timeout_s=args.connect_timeout,
         request_timeout_s=args.request_timeout,
@@ -327,6 +330,13 @@ def build_parser() -> argparse.ArgumentParser:
                  "cdc-aligned-tpu", "cdc-anchored", "cdc-anchored-tpu"],
         help="default 'auto': the flagship anchored pipeline — TPU device "
              "path when a TPU is present, CPU oracle otherwise")
+    serve.add_argument("--cdc-devices", type=int, default=0,
+                       help="shard 'cdc' streaming regions over N JAX "
+                            "devices (0/1 = single-device; boundaries "
+                            "are byte-identical either way)")
+    serve.add_argument("--cdc-region-bytes", type=int, default=0,
+                       help="fixed device-region size for sharded CDC "
+                            "(0 = devices * 1 MiB)")
     serve.add_argument("--min-chunk", type=int, default=2048)
     serve.add_argument("--avg-chunk", type=int, default=8192)
     serve.add_argument("--max-chunk", type=int, default=65536)

@@ -24,8 +24,8 @@ import asyncio
 import time
 from typing import Any
 
-from dfs_tpu.comm.wire import (WireError, pack_chunks, read_msg, send_msg,
-                               unpack_chunks)
+from dfs_tpu.comm.wire import (Buffer, FrameConnection, WireError,
+                               buffers_nbytes, pack_chunks, unpack_chunks)
 from dfs_tpu.config import PeerAddr
 from dfs_tpu.utils.aio import gather_abort_siblings
 
@@ -47,9 +47,12 @@ class RpcRemoteError(RpcError):
 class InternalClient:
     """Storage-plane RPC client with a per-peer persistent-connection
     pool. The server side keeps framed connections open across requests
-    (StorageNodeServer._handle_internal loops until EOF), so reconnecting
-    per call — the reference's behavior, and this client's until round 3
-    — paid a connect round-trip on every has_chunks/store/fetch."""
+    (runtime._serve_internal_frame serves frame after frame until the
+    connection dies), so reconnecting per call — the reference's
+    behavior, and this client's until round 3 — paid a connect
+    round-trip on every has_chunks/store/fetch. Since round 10 each
+    pooled connection is a zero-copy :class:`FrameConnection`
+    (BufferedProtocol receive, scatter-gather send — docs/wire.md)."""
 
     _MAX_IDLE_PER_PEER = 4
 
@@ -65,9 +68,7 @@ class InternalClient:
         # server span parents to it. None (the pre-r09 behavior, and
         # what standalone tools get) changes nothing on the wire.
         self._obs = obs
-        self._pool: dict[tuple[str, int],
-                         list[tuple[asyncio.StreamReader,
-                                    asyncio.StreamWriter]]] = {}
+        self._pool: dict[tuple[str, int], list[FrameConnection]] = {}
         # Per-(peer, digest) single-flight for get_chunk: with the
         # serving tier on, concurrent readers racing to the SAME replica
         # for the SAME immutable chunk collapse into one wire transfer
@@ -79,30 +80,29 @@ class InternalClient:
 
             self._flight = SingleFlight()
 
-    def _checkout(self, peer: PeerAddr):
+    def _checkout(self, peer: PeerAddr) -> FrameConnection | None:
         """Pop a live pooled connection, or None to signal a fresh dial."""
         pool = self._pool.get((peer.host, peer.internal_port))
         while pool:
-            reader, writer = pool.pop()
-            if writer.is_closing() or reader.at_eof():
-                writer.close()
+            conn = pool.pop()
+            if conn.closed:
+                conn.close()
                 continue
-            return reader, writer
+            return conn
         return None
 
-    def _checkin(self, peer: PeerAddr, conn) -> None:
-        reader, writer = conn
+    def _checkin(self, peer: PeerAddr, conn: FrameConnection) -> None:
         pool = self._pool.setdefault((peer.host, peer.internal_port), [])
-        if len(pool) < self._MAX_IDLE_PER_PEER and not writer.is_closing():
+        if len(pool) < self._MAX_IDLE_PER_PEER and not conn.closed:
             pool.append(conn)
         else:
-            writer.close()
+            conn.close()
 
     def close(self) -> None:
         """Drop every pooled connection (node shutdown)."""
         for pool in self._pool.values():
-            for _, writer in pool:
-                writer.close()
+            for conn in pool:
+                conn.close()
         self._pool.clear()
 
     # bulk transfers budget extra time per byte on top of the base
@@ -118,26 +118,33 @@ class InternalClient:
     def _bulk_timeout(self, n_bytes: int) -> float:
         return self.request_timeout_s + n_bytes / self._BULK_BYTES_PER_S
 
-    async def _request(self, conn, header: dict, body: bytes,
-                       timeout_s: float | None = None) -> tuple[dict, bytes]:
+    async def _request(self, conn: FrameConnection, header: dict, body,
+                       timeout_s: float | None = None,
+                       acct: dict | None = None) -> tuple[dict, memoryview]:
         t = self.request_timeout_s if timeout_s is None \
             else max(self.request_timeout_s, timeout_s)
-        _, writer = conn
-        await asyncio.wait_for(send_msg(writer, header, body), timeout=t)
-        return await asyncio.wait_for(read_msg(conn[0]), timeout=t)
+        nsent = await asyncio.wait_for(conn.send(header, body), timeout=t)
+        if acct is not None:
+            acct["out"] += nsent
+        resp, rbody, nrecv = await asyncio.wait_for(conn.reply(), timeout=t)
+        if acct is not None:
+            acct["in"] += nrecv
+        return resp, rbody
 
     async def _call_once(self, peer: PeerAddr, header: dict,
-                         body: bytes,
-                         timeout_s: float | None = None
-                         ) -> tuple[dict, bytes]:
+                         body,
+                         timeout_s: float | None = None,
+                         acct: dict | None = None
+                         ) -> tuple[dict, memoryview]:
         conn = self._checkout(peer)
         reused = conn is not None
         if conn is None:
             conn = await asyncio.wait_for(
-                asyncio.open_connection(peer.host, peer.internal_port),
+                FrameConnection.connect(peer.host, peer.internal_port),
                 timeout=self.connect_timeout_s)
         try:
-            resp, rbody = await self._request(conn, header, body, timeout_s)
+            resp, rbody = await self._request(conn, header, body,
+                                              timeout_s, acct)
         except (ConnectionError, asyncio.IncompleteReadError, WireError):
             # disconnect-class only: a pooled connection the server closed
             # while idle surfaces as reset/EOF on the first frame, and is
@@ -145,20 +152,20 @@ class InternalClient:
             # A request TIMEOUT must NOT take this path: the peer may
             # still be processing, and a silent resend would duplicate
             # work and double the health monitor's fast-fail budget.
-            conn[1].close()
+            conn.close()
             if not reused:
                 raise
             conn = await asyncio.wait_for(
-                asyncio.open_connection(peer.host, peer.internal_port),
+                FrameConnection.connect(peer.host, peer.internal_port),
                 timeout=self.connect_timeout_s)
             try:
                 resp, rbody = await self._request(conn, header, body,
-                                                  timeout_s)
+                                                  timeout_s, acct)
             except BaseException:
-                conn[1].close()
+                conn.close()
                 raise
         except BaseException:
-            conn[1].close()
+            conn.close()
             raise
         # request/response completed: the connection is still in frame
         # sync even for an application-level error — pool it either way
@@ -169,19 +176,25 @@ class InternalClient:
         return resp, rbody
 
     async def call(self, peer: PeerAddr, header: dict,
-                   body: bytes = b"",
+                   body: Buffer | list[Buffer] = b"",
                    retries: int | None = None,
-                   timeout_s: float | None = None) -> tuple[dict, bytes]:
+                   timeout_s: float | None = None
+                   ) -> tuple[dict, memoryview]:
         """Bounded-retry call (reference: 3 attempts, StorageNode.java:208).
-        ``retries`` overrides the default — the node runtime passes 1 for
-        peers its health monitor believes are dead (fast-fail probe).
-        ``timeout_s`` raises (never lowers) the per-attempt budget —
-        bulk ops pass a size-derived value (:meth:`_bulk_timeout`).
+        ``body`` may be one buffer or a buffer list — it rides the wire
+        as a scatter-gather frame, never joined. The returned body is a
+        read-only view of the reply frame (zero-copy). ``retries``
+        overrides the default — the node runtime passes 1 for peers its
+        health monitor believes are dead (fast-fail probe). ``timeout_s``
+        raises (never lowers) the per-attempt budget — bulk ops pass a
+        size-derived value (:meth:`_bulk_timeout`).
 
         With an obs hook: opens an ``rpc.<op>`` span, propagates the
         trace context in the header's optional ``trace`` field (peers
         that predate the field ignore it), and records per-peer per-op
-        count/latency/bytes/errors into the client RPC table."""
+        count/latency/bytes/errors into the client RPC table — byte
+        counts are FRAME sizes (prefix + header + body), what the
+        socket actually carried, summed across retry attempts."""
         obs = self._obs
         if obs is None:
             return await self._call_retrying(peer, header, body, retries,
@@ -194,23 +207,25 @@ class InternalClient:
             if tr is not None:
                 header["trace"] = tr
             t0 = time.perf_counter()
-            nb_in = 0
+            acct = {"out": 0, "in": 0}
             failed = True
             try:
                 resp, rbody = await self._call_retrying(
-                    peer, header, body, retries, timeout_s)
-                nb_in = len(rbody)
+                    peer, header, body, retries, timeout_s, acct)
                 failed = False
-                sp.bytes = len(body) + nb_in
+                sp.bytes = acct["out"] + acct["in"]
                 return resp, rbody
             finally:
                 obs.rpc_client.record(
                     peer.node_id, op, time.perf_counter() - t0,
-                    bytes_out=len(body), bytes_in=nb_in, error=failed)
+                    bytes_out=acct["out"], bytes_in=acct["in"],
+                    error=failed)
 
     async def _call_retrying(self, peer: PeerAddr, header: dict,
-                             body: bytes, retries: int | None,
-                             timeout_s: float | None) -> tuple[dict, bytes]:
+                             body, retries: int | None,
+                             timeout_s: float | None,
+                             acct: dict | None = None
+                             ) -> tuple[dict, memoryview]:
         attempts = retries if retries is not None else self.retries
         op = header.get("op")
         last: Exception | None = None
@@ -218,7 +233,8 @@ class InternalClient:
             if attempt and self._obs is not None:
                 self._obs.rpc_client.retry(peer.node_id, str(op))
             try:
-                return await self._call_once(peer, header, body, timeout_s)
+                return await self._call_once(peer, header, body, timeout_s,
+                                             acct)
             except RpcError:
                 raise  # application-level error: retrying won't help
             except (OSError, asyncio.TimeoutError, RuntimeError) as e:
@@ -232,13 +248,15 @@ class InternalClient:
     # ---- typed ops ----
 
     async def store_chunks(self, peer: PeerAddr, file_id: str,
-                           chunks: list[tuple[str, bytes]]) -> list[str]:
+                           chunks: list[tuple[str, Buffer]]) -> list[str]:
         """Send chunks; returns the receiver's recomputed digests (hash echo,
-        reference contract StorageNode.java:248-257). Caller verifies."""
-        table, body = pack_chunks(chunks)
+        reference contract StorageNode.java:248-257). Caller verifies.
+        Payloads go out as a scatter-gather body — the caller's buffers
+        are written as-is, never joined (docs/wire.md)."""
+        table, bufs = pack_chunks(chunks)
         resp, _ = await self.call(
             peer, {"op": "store_chunks", "fileId": file_id, "chunks": table},
-            body, timeout_s=self._bulk_timeout(len(body)))
+            bufs, timeout_s=self._bulk_timeout(buffers_nbytes(bufs)))
         return list(resp.get("digests", []))
 
     async def store_chunks_windowed(
@@ -294,7 +312,10 @@ class InternalClient:
         await self.call(peer, {"op": "announce", "manifest": manifest_json,
                                "fresh": fresh})
 
-    async def get_chunk(self, peer: PeerAddr, digest: str) -> bytes:
+    async def get_chunk(self, peer: PeerAddr, digest: str) -> memoryview:
+        """Fetch one chunk; the result is a read-only view of the reply
+        frame (zero-copy — callers that need to retain it independently
+        of other references copy explicitly, e.g. the serve cache)."""
         if self._flight is None:
             _, body = await self.call(
                 peer, {"op": "get_chunk", "digest": digest})
@@ -327,9 +348,11 @@ class InternalClient:
     async def get_chunks(self, peer: PeerAddr, digests: list[str],
                          retries: int | None = None,
                          expect_bytes: int = 0
-                         ) -> list[tuple[str, bytes]]:
-        """Batched fetch: returns (digest, bytes) for every requested
-        chunk the peer holds (missing ones are absent — no error).
+                         ) -> list[tuple[str, memoryview]]:
+        """Batched fetch: returns (digest, payload view) for every
+        requested chunk the peer holds (missing ones are absent — no
+        error). Payloads are read-only slices of the ONE reply frame —
+        zero-copy; referencing any of them pins the frame buffer.
         ``retries`` as in :meth:`call` (callers pass 1 for known-dead
         peers); ``expect_bytes`` sizes the timeout for the expected
         response payload."""

@@ -21,6 +21,27 @@ bidirectional by construction: receivers that predate the field ignore
 unknown header keys, and receivers that understand it treat a frame
 without (or with a malformed) ``trace`` exactly like one from an
 untraced caller. The field never affects op semantics.
+
+Round 10 makes the frame layer **zero-copy** (docs/wire.md):
+
+- a body may be a *sequence of buffers* (``bytes | bytearray |
+  memoryview``): :func:`send_msg` and the framed connections below write
+  the prefix, header, and each buffer straight to the transport — never
+  joining them into one bytes object. (``StreamWriter.writelines`` is
+  the natural spelling, but CPython < 3.12's selector transport
+  implements it as ``b"".join`` — exactly the copy being eliminated —
+  so buffers are flushed as individual writes, which go straight to
+  ``send(2)`` whenever the transport buffer is empty.)
+- the receive side is :class:`asyncio.BufferedProtocol` based
+  (:class:`FrameConnection` / :class:`FrameServerProtocol`): the kernel
+  copies each frame ONCE into a per-frame buffer via ``recv_into`` —
+  no StreamReader byte-buffer shuffling (which measured ~3 passes over
+  every body) — and :func:`unpack_chunks` hands out read-only
+  memoryview slices of it instead of per-chunk copies.
+
+The stream-based :func:`send_msg` / :func:`read_msg` remain the
+compatibility surface (tests, tooling, pre-r10 interop): the bytes on
+the wire are identical.
 """
 
 from __future__ import annotations
@@ -28,25 +49,83 @@ from __future__ import annotations
 import asyncio
 import json
 import struct
+from typing import Sequence, Union
 
 MAGIC = 0x44465301
 _PREFIX = struct.Struct(">IIQ")
+PREFIX_LEN = _PREFIX.size
 MAX_HEADER = 64 * 1024 * 1024
 MAX_BODY = 8 * 1024 * 1024 * 1024
+
+# one payload buffer; a frame body is one of these or a sequence of them
+Buffer = Union[bytes, bytearray, memoryview]
 
 
 class WireError(RuntimeError):
     pass
 
 
-async def send_msg(writer: asyncio.StreamWriter, header: dict,
-                   body: bytes = b"") -> None:
+def as_buffers(body: Buffer | Sequence[Buffer]) -> list[Buffer]:
+    """Normalize a body argument to a flat buffer list (a single buffer
+    becomes a one-element list; a sequence is taken as-is)."""
+    if isinstance(body, (bytes, bytearray, memoryview)):
+        return [body]
+    return list(body)
+
+
+def buffers_nbytes(body: Buffer | Sequence[Buffer]) -> int:
+    if isinstance(body, (bytes, bytearray, memoryview)):
+        return len(body)
+    return sum(len(b) for b in body)
+
+
+def encode_frame(header: dict, body: Buffer | Sequence[Buffer] = b""
+                 ) -> tuple[bytes, list[Buffer], int]:
+    """-> (prefix+header bytes, body buffer list, total frame length).
+    The one place a frame is laid out, shared by every send path — so
+    byte accounting (``total``) is by construction what the socket
+    carries."""
     h = json.dumps(header, separators=(",", ":")).encode()
-    writer.write(_PREFIX.pack(MAGIC, len(h), len(body)))
-    writer.write(h)
-    if body:
-        writer.write(body)
+    bufs = as_buffers(body)
+    body_len = sum(len(b) for b in bufs)
+    head = _PREFIX.pack(MAGIC, len(h), body_len) + h
+    return head, bufs, len(head) + body_len
+
+
+def frame_size(header: dict, body_len: int) -> int:
+    """Exact on-wire size of a frame with this header and body length."""
+    h = json.dumps(header, separators=(",", ":")).encode()
+    return PREFIX_LEN + len(h) + body_len
+
+
+def _decode_header(raw: Buffer) -> dict:
+    """Parse + validate a frame header; any malformation is a
+    :class:`WireError` (a peer sending garbage must fail the frame, not
+    leak a JSONDecodeError / AttributeError into op dispatch)."""
+    try:
+        # header-only copy (≤ a few KB): json.loads rejects memoryviews
+        header = json.loads(bytes(raw))  # dfslint: ignore[DFS006]
+    except (ValueError, UnicodeDecodeError) as e:
+        raise WireError(f"bad frame header: {e}") from e
+    if not isinstance(header, dict):
+        raise WireError(
+            f"bad frame header: want a JSON object, got {type(header).__name__}")
+    return header
+
+
+async def send_msg(writer: asyncio.StreamWriter, header: dict,
+                   body: Buffer | Sequence[Buffer] = b"") -> int:
+    """Write one frame; returns the frame's total on-wire byte count.
+    ``body`` may be a single buffer or a sequence of buffers — buffers
+    are written individually (vectored send, no join; see module
+    docstring for the writelines caveat)."""
+    head, bufs, total = encode_frame(header, body)
+    writer.write(head)
+    for b in bufs:
+        if len(b):
+            writer.write(b)
     await writer.drain()
+    return total
 
 
 async def read_msg(reader: asyncio.StreamReader) -> tuple[dict, bytes]:
@@ -60,27 +139,351 @@ async def read_msg(reader: asyncio.StreamReader) -> tuple[dict, bytes]:
     if hdr_len > MAX_HEADER or body_len > MAX_BODY:
         raise WireError("frame too large")
     try:
-        header = json.loads(await reader.readexactly(hdr_len))
+        header = _decode_header(await reader.readexactly(hdr_len))
         body = await reader.readexactly(body_len) if body_len else b""
     except asyncio.IncompleteReadError as e:
         raise WireError("connection closed mid-frame") from e
     return header, body
 
 
-def pack_chunks(chunks: list[tuple[str, bytes]]) -> tuple[list[dict], bytes]:
-    """[(digest, data)] → (header chunk table, concatenated body)."""
+def pack_chunks(chunks: Sequence[tuple[str, Buffer]]
+                ) -> tuple[list[dict], list[Buffer]]:
+    """[(digest, data)] → (header chunk table, body buffer list).
+    The buffers are the callers' own objects — NOT joined; they flow to
+    the transport as a scatter-gather body (docs/wire.md ownership
+    rules: the caller must not mutate them until the send completes)."""
     table = [{"digest": d, "length": len(b)} for d, b in chunks]
-    return table, b"".join(b for _, b in chunks)
+    return table, [b for _, b in chunks]
 
 
-def unpack_chunks(table: list[dict], body: bytes) -> list[tuple[str, bytes]]:
-    out, off = [], 0
+def unpack_chunks(table: list[dict], body: Buffer
+                  ) -> list[tuple[str, memoryview]]:
+    """Chunk table + body → [(digest, payload view)]. Payloads are
+    READ-ONLY memoryview slices of ``body`` — zero-copy; they pin the
+    body buffer for as long as any of them is referenced."""
+    mv = body if isinstance(body, memoryview) else memoryview(body)
+    if not mv.readonly:
+        mv = mv.toreadonly()
+    out: list[tuple[str, memoryview]] = []
+    off = 0
     for entry in table:
-        ln = int(entry["length"])
-        if off + ln > len(body):
+        try:
+            ln = int(entry["length"])
+            digest = entry["digest"]
+        except (TypeError, ValueError, KeyError) as e:
+            # malformed table entry is as recoverable as corrupt bytes —
+            # callers catch WireError and fall back to other replicas
+            raise WireError(f"malformed chunk table entry: {e!r}") from e
+        if ln < 0 or off + ln > len(mv):
             raise WireError("chunk table overruns body")
-        out.append((entry["digest"], body[off:off + ln]))
+        out.append((digest, mv[off:off + ln]))
         off += ln
-    if off != len(body):
+    if off != len(mv):
         raise WireError("body has trailing bytes")
     return out
+
+
+# --------------------------------------------------------------------- #
+# zero-copy framed connections (BufferedProtocol)
+# --------------------------------------------------------------------- #
+
+class _FrameReceiver(asyncio.BufferedProtocol):
+    """Shared receive machine: the transport ``recv_into``s directly
+    into (a) a 16-byte prefix scratch, then (b) ONE per-frame
+    ``bytearray(hdr_len + body_len)`` — a single kernel→frame copy per
+    frame. ``_on_frame(header, body_view, frame_len)`` fires with a
+    read-only view of the body; ``_on_broken(exc)`` fires once when the
+    connection dies (malformed frame, EOF, reset).
+
+    Subclasses get outbound flow control too: ``_write_frame`` +
+    ``await _drain()`` honor ``pause_writing`` exactly like streams.
+    """
+
+    def __init__(self) -> None:
+        self._transport: asyncio.Transport | None = None
+        self._prefix = bytearray(PREFIX_LEN)
+        self._pmv = memoryview(self._prefix)
+        self._frame: bytearray | None = None
+        self._fmv: memoryview | None = None
+        self._hdr_len = 0
+        self._got = 0
+        self._broken: Exception | None = None
+        self._send_paused = False
+        self._drain_waiters: list[asyncio.Future] = []
+
+    # ---- protocol callbacks ----
+
+    def connection_made(self, transport) -> None:  # noqa: D401
+        self._transport = transport
+
+    def get_buffer(self, sizehint: int) -> memoryview:
+        if self._frame is None:
+            return self._pmv[self._got:]
+        return self._fmv[self._got:]
+
+    def buffer_updated(self, nbytes: int) -> None:
+        if self._broken is not None:
+            return   # dying transport may still deliver buffered bytes
+        self._got += nbytes
+        if self._frame is None:
+            if self._got < PREFIX_LEN:
+                return
+            magic, hdr_len, body_len = _PREFIX.unpack(self._prefix)
+            if magic != MAGIC:
+                self._die(WireError(f"bad magic {magic:#x}"))
+                return
+            if hdr_len > MAX_HEADER or body_len > MAX_BODY:
+                # validated BEFORE the allocation: an adversarial prefix
+                # must not make the receiver allocate gigabytes
+                self._die(WireError("frame too large"))
+                return
+            self._hdr_len = hdr_len
+            self._got = 0
+            if hdr_len + body_len == 0:
+                self._deliver(bytearray())
+                return
+            self._frame = bytearray(hdr_len + body_len)
+            self._fmv = memoryview(self._frame)
+            return
+        if self._got >= len(self._frame):
+            frame, self._frame, self._fmv = self._frame, None, None
+            self._got = 0
+            self._deliver(frame)
+
+    def _deliver(self, frame: bytearray) -> None:
+        fv = memoryview(frame).toreadonly()
+        try:
+            header = _decode_header(fv[:self._hdr_len])
+        except WireError as e:
+            self._die(e)
+            return
+        self._on_frame(header, fv[self._hdr_len:],
+                       PREFIX_LEN + len(frame))
+
+    def eof_received(self) -> bool:
+        self._fail(WireError("connection closed mid-frame")
+                   if (self._frame is not None or self._got)
+                   else ConnectionResetError("connection closed"))
+        return False     # let the transport close
+
+    def connection_lost(self, exc: Exception | None) -> None:
+        self._fail(exc if exc is not None
+                   else ConnectionResetError("connection lost"))
+        # wake writers parked in _drain so they see the failure
+        self._send_paused = False
+        for fut in self._drain_waiters:
+            if not fut.done():
+                fut.set_result(None)
+        self._drain_waiters.clear()
+
+    def pause_writing(self) -> None:
+        self._send_paused = True
+
+    def resume_writing(self) -> None:
+        self._send_paused = False
+        for fut in self._drain_waiters:
+            if not fut.done():
+                fut.set_result(None)
+        self._drain_waiters.clear()
+
+    # ---- shared plumbing ----
+
+    def _die(self, exc: Exception) -> None:
+        """Protocol violation: record the cause and drop the connection
+        PROMPTLY — a malformed frame leaves the stream unparseable, so
+        the only safe move is teardown (no hang, no desync)."""
+        self._fail(exc)
+        if self._transport is not None:
+            self._transport.close()
+
+    def _fail(self, exc: Exception) -> None:
+        if self._broken is None:
+            self._broken = exc
+            self._on_broken(exc)
+
+    def _write_frame(self, header: dict,
+                     body: Buffer | Sequence[Buffer] = b"") -> int:
+        """Vectored frame write (prefix+header, then each buffer as-is);
+        returns the frame's on-wire size. Raises if the connection
+        already failed."""
+        head, bufs, total = encode_frame(header, body)
+        self._write_encoded(head, bufs)
+        return total
+
+    def _write_encoded(self, head: bytes, bufs: Sequence[Buffer]) -> None:
+        if self._broken is not None:
+            raise self._broken
+        if self._transport is None or self._transport.is_closing():
+            raise ConnectionResetError("connection is closed")
+        self._transport.write(head)
+        for b in bufs:
+            if len(b):
+                self._transport.write(b)
+
+    async def _drain(self) -> None:
+        if self._broken is not None:
+            raise self._broken
+        if not self._send_paused:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._drain_waiters.append(fut)
+        await fut
+        if self._broken is not None:
+            raise self._broken
+
+    def close(self) -> None:
+        if self._transport is not None:
+            self._transport.close()
+
+    @property
+    def closed(self) -> bool:
+        return (self._broken is not None or self._transport is None
+                or self._transport.is_closing())
+
+    # ---- subclass surface ----
+
+    def _on_frame(self, header: dict, body: memoryview,
+                  frame_len: int) -> None:
+        raise NotImplementedError
+
+    def _on_broken(self, exc: Exception) -> None:
+        raise NotImplementedError
+
+
+class FrameConnection(_FrameReceiver):
+    """Client side of the storage plane: one pooled connection carrying
+    strictly request→reply frames (the pool dials more connections for
+    concurrency — see InternalClient). Replaces the StreamReader-based
+    client path; the on-wire bytes are unchanged.
+
+    Usage::
+
+        conn = await FrameConnection.connect(host, port)
+        nsent = await conn.send(header, bufs)     # vectored, drained
+        resp, body, nrecv = await conn.reply()    # zero-copy body view
+    """
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._waiter: asyncio.Future | None = None
+
+    @classmethod
+    async def connect(cls, host: str, port: int) -> "FrameConnection":
+        loop = asyncio.get_running_loop()
+        _, conn = await loop.create_connection(cls, host, port)
+        return conn
+
+    async def send(self, header: dict,
+                   body: Buffer | Sequence[Buffer] = b"") -> int:
+        """Write one request frame (returns its on-wire size) and
+        register for its reply. One request may be outstanding per
+        connection — the contract the pool's checkout/checkin already
+        enforces."""
+        if self._waiter is not None:
+            raise RuntimeError("request already in flight on this "
+                               "connection")
+        # registered BEFORE the drain await: the reply may arrive while
+        # the send is still draining
+        self._waiter = asyncio.get_running_loop().create_future()
+        try:
+            n = self._write_frame(header, body)
+            await self._drain()
+        except BaseException:
+            self._waiter = None
+            raise
+        return n
+
+    async def reply(self) -> tuple[dict, memoryview, int]:
+        """-> (response header, read-only body view, frame byte count).
+        The body view borrows the per-frame receive buffer — it stays
+        valid for as long as the caller references it."""
+        fut = self._waiter
+        if fut is None:
+            raise RuntimeError("no request in flight")
+        try:
+            return await fut
+        finally:
+            self._waiter = None
+
+    def _on_frame(self, header: dict, body: memoryview,
+                  frame_len: int) -> None:
+        fut = self._waiter
+        if fut is None or fut.done():
+            # unsolicited frame: the connection is out of sync — drop it
+            self._die(WireError("unsolicited frame"))
+            return
+        fut.set_result((header, body, frame_len))
+
+    def _on_broken(self, exc: Exception) -> None:
+        fut = self._waiter
+        if fut is not None and not fut.done():
+            fut.set_exception(exc)
+
+
+class FrameServerProtocol(_FrameReceiver):
+    """Server side: frames are served STRICTLY one at a time per
+    connection — reading pauses while a frame is in service (the same
+    backpressure the stream loop had), and ``get_buffer`` bounds every
+    recv to the current frame, so a frame is never read ahead of the
+    previous one's reply.
+
+    ``handler(conn, header, body_view, frame_len)`` is awaited per
+    frame; it replies via ``conn.send_frame(...)`` + ``await
+    conn.drain()``. A handler exception tears the connection down (the
+    node runtime's handler converts op errors to error replies itself,
+    so anything reaching here is a protocol-level failure)."""
+
+    def __init__(self, handler, on_connect=None, on_close=None) -> None:
+        super().__init__()
+        self._handler = handler
+        self._on_connect = on_connect
+        self._on_close = on_close
+        self._task: asyncio.Task | None = None   # retained: DFS002
+
+    def connection_made(self, transport) -> None:
+        super().connection_made(transport)
+        if self._on_connect is not None:
+            self._on_connect(self)
+
+    def _on_frame(self, header: dict, body: memoryview,
+                  frame_len: int) -> None:
+        self._transport.pause_reading()
+        self._task = asyncio.get_running_loop().create_task(
+            self._serve(header, body, frame_len))
+        self._task.add_done_callback(self._served)
+
+    async def _serve(self, header: dict, body: memoryview,
+                     frame_len: int) -> None:
+        await self._handler(self, header, body, frame_len)
+
+    def _served(self, task: asyncio.Task) -> None:
+        self._task = None
+        if not task.cancelled() and task.exception() is not None:
+            self._die(WireError(
+                f"handler failed: {task.exception()!r}"))
+            return
+        if self._broken is None and self._transport is not None \
+                and not self._transport.is_closing():
+            self._transport.resume_reading()
+
+    def send_frame(self, header: dict,
+                   body: Buffer | Sequence[Buffer] = b"") -> int:
+        return self._write_frame(header, body)
+
+    def send_encoded(self, head: bytes, bufs: Sequence[Buffer]) -> None:
+        """Write a frame the caller already laid out via
+        :func:`encode_frame` (so the header is encoded exactly once —
+        the node runtime needs the reply's byte count for its span
+        BEFORE sending)."""
+        self._write_encoded(head, bufs)
+
+    async def drain(self) -> None:
+        await self._drain()
+
+    def _on_broken(self, exc: Exception) -> None:
+        # an in-service frame's task is NOT cancelled: ops complete (and
+        # fail at the reply write) exactly like the pre-r10 stream loop
+        # — a peer hanging up must not abort a half-applied op that the
+        # handler would have finished atomically
+        if self._on_close is not None:
+            self._on_close(self)

@@ -139,6 +139,37 @@ class ClusterConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FragmenterConfig:
+    """Execution knobs of the fragmenter plugin — the *how it runs*
+    (device sharding), vs :class:`CDCParams`' *what it computes* (chunk
+    boundaries, which these knobs must never change).
+
+    ``devices > 1`` shards streaming-CDC regions over that many JAX
+    devices via ``parallel/sharded_cdc.make_sharded_bitmap_step`` (the
+    31-byte Gear halo rides the sp ring via ppermute; the stream's
+    region-to-region halo is carried in host-side) — chunk boundaries
+    stay BYTE-IDENTICAL to the single-device path by construction
+    (tests/test_sharded_ingest.py asserts it). With fewer devices
+    visible than asked, the fragmenter logs once and runs single-device.
+    """
+
+    devices: int = 0        # 0/1 = single-device CDC; N > 1 = shard
+                            # regions over N JAX devices when visible
+    region_bytes: int = 0   # fixed device-region size streaming input is
+                            # re-blocked to (the sharded step compiles
+                            # ONCE for this shape); 0 = devices * 1 MiB
+
+    def __post_init__(self) -> None:
+        if self.devices < 0:
+            raise ValueError("devices must be >= 0")
+        if self.region_bytes < 0:
+            raise ValueError("region_bytes must be >= 0")
+        if self.region_bytes and self.devices > 1 \
+                and self.region_bytes % self.devices:
+            raise ValueError("region_bytes must divide evenly over devices")
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Read-path serving tier (dfs_tpu.serve) — hot-chunk cache,
     single-flight coalescing, admission control, readahead.
@@ -246,6 +277,10 @@ class NodeConfig:
     sidecar_port: int | None = None  # delegate chunk+hash to a sidecar
                                      # process (overrides `fragmenter`)
     cdc: CDCParams = dataclasses.field(default_factory=CDCParams)
+    # fragmenter execution knobs (multi-device CDC sharding); the default
+    # FragmenterConfig() is the historical single-device behavior
+    frag: FragmenterConfig = dataclasses.field(
+        default_factory=FragmenterConfig)
     fixed_parts: int = 5           # FixedFragmenter part count (reference: TOTAL_NODES=5)
     connect_timeout_s: float = 2.0  # reference: 2000 ms, StorageNode.java:229-230
     request_timeout_s: float = 10.0

@@ -365,12 +365,18 @@ def _anchored_params(cdc_params):
     return AnchoredCdcParams()
 
 
-def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5) -> Fragmenter:
+def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5,
+                   frag=None) -> Fragmenter:
     """Factory keyed by NodeConfig.fragmenter. ``"auto"`` (the serve
     default) resolves to the flagship anchored pipeline: the TPU device
     path when a TPU is present, its CPU oracle otherwise — a default
     deployment on accelerated hardware must actually use the accelerator
-    — re-probing the staging link periodically (AutoAnchoredFragmenter)."""
+    — re-probing the staging link periodically (AutoAnchoredFragmenter).
+
+    ``frag`` (a FragmenterConfig) carries execution knobs: with
+    ``frag.devices > 1`` the ``"cdc"`` strategy's streaming walk shards
+    regions over that many JAX devices (fragmenter/cdc_sharded.py) —
+    byte-identical chunk boundaries, multi-chip throughput."""
     import warnings
 
     from dfs_tpu.config import CDCParams
@@ -406,6 +412,10 @@ def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5) -> Fragm
         return cls(params)
     params = cdc_params or CDCParams()
     if kind == "cdc":
+        if frag is not None and frag.devices > 1:
+            from dfs_tpu.fragmenter.cdc_sharded import ShardedCdcFragmenter
+
+            return ShardedCdcFragmenter(params, frag)
         return CpuCdcFragmenter(params)
     if kind == "cdc-tpu":
         warnings.warn(

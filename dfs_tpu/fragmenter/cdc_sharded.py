@@ -1,0 +1,111 @@
+"""Multi-device streaming CDC — the ingest option behind
+``FragmenterConfig.devices`` (round 10, ROADMAP item 5b).
+
+``CpuCdcFragmenter`` with ONE substitution: the streaming bitmap kernel
+(the pluggable ``bitmap_fn`` seam ``fragmenter/stream.py`` was built
+around) runs regions through ``parallel/sharded_cdc.
+make_sharded_bitmap_step`` — the windowed Gear bitmap computed as one
+SPMD program over a ('dp','sp') mesh, the 31-byte window halo exchanged
+between sp-ring neighbors via ``lax.ppermute`` and the stream's
+region-to-region halo carried in as an explicit input. Everything else
+(greedy cut selection, hashing, manifests, the resume ``describe()``)
+is inherited unchanged, so chunk boundaries and digests are
+BYTE-IDENTICAL to the single-device path by construction —
+tests/test_sharded_ingest.py asserts it against the CPU oracle, and
+WIRE_r10.json carries the resident multi-device throughput claim.
+
+Streaming input is re-blocked to a FIXED region size
+(``FragmenterConfig.region_bytes``, default ``devices`` MiB) so the
+sharded step traces/compiles exactly once; the stream's ragged final
+region falls back to the NumPy kernel (identical bitmap, no recompile).
+Fewer visible JAX devices than configured logs once and runs the CPU
+path — a degraded environment must not fail ingest.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from dfs_tpu.config import GEAR_HALO as HALO
+from dfs_tpu.config import CDCParams, FragmenterConfig
+from dfs_tpu.fragmenter.cdc_cpu import CpuCdcFragmenter
+from dfs_tpu.meta.manifest import Manifest
+
+
+class ShardedCdcFragmenter(CpuCdcFragmenter):
+    """CpuCdcFragmenter whose streaming bitmap is sharded over JAX
+    devices. Same ``name``/``describe()`` as the CPU engine — manifests
+    record the *strategy*, and the strategy's output is identical."""
+
+    def __init__(self, params: CDCParams | None = None,
+                 frag: FragmenterConfig | None = None) -> None:
+        super().__init__(params)
+        frag = frag or FragmenterConfig(devices=2)
+        self.devices = max(2, int(frag.devices))
+        rb = frag.region_bytes or self.devices * (1 << 20)
+        # per-device spans must be equal (static shapes) and long enough
+        # to source the 31-value ring halo from their own tile
+        self.region_bytes = max(self.devices * 4 * HALO,
+                                rb // self.devices * self.devices)
+        self._step = None        # lazy: jax untouched until first stream
+        self._mesh = None
+        self._unavailable = False
+
+    # ---- device plumbing ----
+
+    def _ensure_step(self):
+        if self._step is not None or self._unavailable:
+            return self._step
+        try:
+            import jax
+
+            from dfs_tpu.parallel.mesh import make_mesh
+            from dfs_tpu.parallel.sharded_cdc import \
+                make_sharded_bitmap_step
+
+            if len(jax.devices()) < self.devices:
+                raise RuntimeError(
+                    f"{self.devices} devices configured, "
+                    f"{len(jax.devices())} visible")
+            # dp=1: one stream, its byte axis tiled over every device
+            self._mesh = make_mesh(self.devices, dp=1)
+            self._step = make_sharded_bitmap_step(
+                self._mesh, self.table, self.params.mask)
+        except Exception as e:  # noqa: BLE001 - degrade, don't fail ingest
+            self._unavailable = True
+            logging.getLogger("dfs_tpu.fragmenter").warning(
+                "sharded CDC unavailable (%s); running single-device", e)
+        return self._step
+
+    # ---- the substituted kernel ----
+
+    def bitmap_tile(self, arr: np.ndarray,
+                    prev_g: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        step = self._ensure_step()
+        if step is None or arr.shape[0] != self.region_bytes:
+            # ragged final region / degraded environment: the NumPy
+            # kernel computes the SAME bitmap (single source of truth
+            # for halos — gear_bitmap_carry), no device recompile
+            return super().bitmap_tile(arr, prev_g)
+        import jax
+
+        from dfs_tpu.parallel.sharded_cdc import shard_bitmap_inputs
+
+        data, head = shard_bitmap_inputs(
+            self._mesh, np.ascontiguousarray(arr)[None, :],
+            np.ascontiguousarray(prev_g)[None, :])
+        bitmap = np.asarray(jax.block_until_ready(step(data, head)))[0]
+        # next region's carry halo: Gear table values of the last
+        # 31 bytes (region_bytes >> HALO, so no prev_g splice needed)
+        return bitmap, self.table[arr[-HALO:]].astype(np.uint32)
+
+    def manifest_stream(self, blocks, name: str, store=None) -> Manifest:
+        from dfs_tpu.fragmenter.stream import manifest_from_stream, reblock
+
+        # fixed-size regions -> ONE compiled step shape for the whole
+        # stream (only the final ragged region takes the NumPy path)
+        return manifest_from_stream(
+            reblock(blocks, self.region_bytes), self.params,
+            self.bitmap_tile, name, self.name, store)

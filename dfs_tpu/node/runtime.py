@@ -34,8 +34,8 @@ from collections import deque
 from typing import Mapping, Sequence
 
 from dfs_tpu.comm.rpc import InternalClient, RpcError, RpcUnreachable
-from dfs_tpu.comm.wire import (WireError, pack_chunks, read_msg, send_msg,
-                               unpack_chunks)
+from dfs_tpu.comm.wire import (FrameServerProtocol, WireError, encode_frame,
+                               pack_chunks, unpack_chunks)
 from dfs_tpu.config import NodeConfig
 from dfs_tpu.fragmenter.base import get_fragmenter
 from dfs_tpu.meta.manifest import (ChunkRef, EcInfo, Manifest, StripeRef,
@@ -233,7 +233,7 @@ class StorageNodeServer:
         else:
             self.fragmenter = get_fragmenter(
                 cfg.fragmenter, cdc_params=cfg.cdc,
-                fixed_parts=cfg.fixed_parts)
+                fixed_parts=cfg.fixed_parts, frag=cfg.frag)
         self.client = InternalClient(cfg.connect_timeout_s,
                                      cfg.request_timeout_s, cfg.retries,
                                      coalesce_fetches=cfg.serve.cache_bytes
@@ -251,7 +251,7 @@ class StorageNodeServer:
         self.under_replicated: set[str] = set()  # digests needing repair
         self._internal_server: asyncio.AbstractServer | None = None
         self._http_server: asyncio.AbstractServer | None = None
-        self._inbound: set[asyncio.StreamWriter] = set()  # live peer conns
+        self._inbound: set[FrameServerProtocol] = set()  # live peer conns
 
     # ------------------------------------------------------------------ #
     # lifecycle
@@ -261,8 +261,16 @@ class StorageNodeServer:
         from dfs_tpu.api.http import make_http_handler
 
         addr = self.cfg.self_addr
-        self._internal_server = await asyncio.start_server(
-            self._handle_internal, addr.host, addr.internal_port)
+        # the internal plane is a BufferedProtocol server (comm/wire.py):
+        # each inbound frame lands in ONE recv_into buffer and is served
+        # by _serve_internal_frame — no StreamReader byte shuffling on
+        # the hot receive path (docs/wire.md)
+        loop = asyncio.get_running_loop()
+        self._internal_server = await loop.create_server(
+            lambda: FrameServerProtocol(self._serve_internal_frame,
+                                        on_connect=self._inbound.add,
+                                        on_close=self._inbound.discard),
+            addr.host, addr.internal_port)
         self._http_server = await asyncio.start_server(
             make_http_handler(self), addr.host, addr.port)
         if self.cfg.health_probe_s > 0:
@@ -289,72 +297,71 @@ class StorageNodeServer:
     # internal storage plane (server side)
     # ------------------------------------------------------------------ #
 
-    async def _handle_internal(self, reader: asyncio.StreamReader,
-                               writer: asyncio.StreamWriter) -> None:
-        self._inbound.add(writer)
-        try:
-            while True:
-                try:
-                    header, body = await read_msg(reader)
-                except WireError:
-                    return
-                # trace context off the wire: the OPTIONAL `trace` field
-                # names the caller's rpc span — this op's span (and every
-                # span it opens downstream: cas, admission waits) parents
-                # to it, which is what makes cluster stitching possible.
-                # Absent/malformed (pre-r09 peers) roots a fresh trace —
-                # but only for the HEAVY ops: rooting every untraced
-                # health probe / background repair call would mint a
-                # steady stream of unqueryable single-span traces that
-                # evict client-tagged spans from the bounded ring (the
-                # same probe-noise reasoning that exempts cheap ops from
-                # the internal admission gate).
-                op = header.get("op")
-                tr = parse_wire_trace(header.get("trace"))
-                t0 = time.perf_counter()
-                with (self.obs.server_span(f"peer.{op}", tr)
-                      if tr is not None or op in _HEAVY_OPS
-                      else contextlib.nullcontext(_NULL_OBS_SPAN)) as sp:
-                    sp.bytes = len(body)
-                    try:
-                        gate = self.serve.admission.internal
-                        if gate.enabled and op in _HEAVY_OPS:
-                            # bounded storage-plane concurrency for the
-                            # BULK ops only; a shed op surfaces to the
-                            # peer as an application error
-                            # (RpcRemoteError — live peer, not a death
-                            # sign). Cheap O(1)/metadata ops — health
-                            # above all — bypass the gate: a health
-                            # probe queued behind multi-second transfers
-                            # past the prober's timeout would make a
-                            # merely BUSY node look dead and trigger
-                            # repair churn.
-                            async with gate.slot():
-                                resp, rbody = await self._dispatch(header,
-                                                                   body)
-                        else:
-                            resp, rbody = await self._dispatch(header, body)
-                        sp.bytes += len(rbody)
-                    except Exception as e:  # noqa: BLE001 - report to peer
-                        sp.err = type(e).__name__
-                        resp, rbody = {"ok": False, "error": str(e)}, b""
-                self.obs.rpc_server.record(
-                    tr[2] if tr is not None and tr[2] is not None else "-",
-                    str(op), time.perf_counter() - t0,
-                    bytes_out=len(rbody), bytes_in=len(body),
-                    error=not resp.get("ok", False))
-                await send_msg(writer, resp, rbody)
-        except (ConnectionError, OSError):
-            pass
-        finally:
-            self._inbound.discard(writer)
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except (ConnectionError, OSError):
-                pass
+    async def _serve_internal_frame(self, conn, header: dict,
+                                    body: memoryview,
+                                    nbytes_in: int) -> None:
+        """Serve ONE inbound storage-plane frame (the FrameServerProtocol
+        awaits this per frame, strictly sequentially per connection —
+        the same ordering the pre-r10 stream loop had). ``body`` is a
+        read-only view of the frame's receive buffer (zero-copy all the
+        way into CAS writes); ``nbytes_in`` is the frame's full on-wire
+        size, which is what the RPC tables and span byte counts record
+        (headers included — /metrics matches what the socket carried).
 
-    async def _dispatch(self, header: dict, body: bytes) -> tuple[dict, bytes]:
+        Trace context off the wire: the OPTIONAL `trace` field names the
+        caller's rpc span — this op's span (and every span it opens
+        downstream: cas, admission waits) parents to it, which is what
+        makes cluster stitching possible. Absent/malformed (pre-r09
+        peers) roots a fresh trace — but only for the HEAVY ops: rooting
+        every untraced health probe / background repair call would mint
+        a steady stream of unqueryable single-span traces that evict
+        client-tagged spans from the bounded ring (the same probe-noise
+        reasoning that exempts cheap ops from the internal admission
+        gate)."""
+        op = header.get("op")
+        tr = parse_wire_trace(header.get("trace"))
+        t0 = time.perf_counter()
+        with (self.obs.server_span(f"peer.{op}", tr)
+              if tr is not None or op in _HEAVY_OPS
+              else contextlib.nullcontext(_NULL_OBS_SPAN)) as sp:
+            sp.bytes = nbytes_in
+            try:
+                gate = self.serve.admission.internal
+                if gate.enabled and op in _HEAVY_OPS:
+                    # bounded storage-plane concurrency for the
+                    # BULK ops only; a shed op surfaces to the
+                    # peer as an application error
+                    # (RpcRemoteError — live peer, not a death
+                    # sign). Cheap O(1)/metadata ops — health
+                    # above all — bypass the gate: a health
+                    # probe queued behind multi-second transfers
+                    # past the prober's timeout would make a
+                    # merely BUSY node look dead and trigger
+                    # repair churn.
+                    async with gate.slot():
+                        resp, rbody = await self._dispatch(header, body)
+                else:
+                    resp, rbody = await self._dispatch(header, body)
+            except Exception as e:  # noqa: BLE001 - report to peer
+                sp.err = type(e).__name__
+                resp, rbody = {"ok": False, "error": str(e)}, b""
+            # reply encoded inside the span so sp.bytes carries the real
+            # frame total; the buffers themselves are NOT joined — they
+            # go to the transport one by one below
+            head, bufs, nbytes_out = encode_frame(resp, rbody)
+            sp.bytes = nbytes_in + nbytes_out
+        self.obs.rpc_server.record(
+            tr[2] if tr is not None and tr[2] is not None else "-",
+            str(op), time.perf_counter() - t0,
+            bytes_out=nbytes_out, bytes_in=nbytes_in,
+            error=not resp.get("ok", False))
+        try:
+            conn.send_encoded(head, bufs)
+            await conn.drain()
+        except (ConnectionError, OSError, WireError):
+            conn.close()   # peer went away mid-reply: nothing to salvage
+
+    async def _dispatch(self, header: dict, body) -> tuple[dict, object]:
         op = header.get("op")
         if op == "store_chunks":
             # Hash echo: recompute every digest from the received bytes
@@ -430,8 +437,10 @@ class StorageNodeServer:
             # touch — a burst of peer batched fetches must not stack
             # unbounded executor jobs.
             have = await self.cas.get_many(header.get("digests", []))
-            table, body = pack_chunks(have)
-            return {"ok": True, "chunks": table}, body
+            table, bufs = pack_chunks(have)
+            # buffer list straight from CAS reads to the socket — the
+            # reply body is never joined (zero-copy data plane)
+            return {"ok": True, "chunks": table}, bufs
         if op == "get_manifest":
             m = self.store.manifests.load(header["fileId"])
             return {"ok": True,
@@ -483,12 +492,16 @@ class StorageNodeServer:
         stats["bytes"] = len(data)
         seen: set[str] = set()
         batch: list[tuple[str, bytes]] = []
+        view = memoryview(data).toreadonly()
         for c in manifest.chunks:
             if c.digest in seen:
                 continue  # duplicate content within the file: place once
             seen.add(c.digest)
-            # slice once; the same bytes object is shared across targets
-            batch.append((c.digest, data[c.offset:c.offset + c.length]))
+            # read-only VIEW per chunk, shared across every target —
+            # pre-r10 this was a bytes slice per chunk (a full-corpus
+            # copy before a byte hit the wire); views flow untouched
+            # through CAS puts and scatter-gather peer sends
+            batch.append((c.digest, view[c.offset:c.offset + c.length]))
         stats["uniqueChunks"] = len(seen)
         placement = None
         rf = None
@@ -1644,14 +1657,18 @@ class StorageNodeServer:
 
     async def download_range(self, file_id: str, first: int | None,
                              last: int | None
-                             ) -> tuple[Manifest, bytes, int, int]:
+                             ) -> tuple[Manifest, list, int, int]:
         """Serve an HTTP-style byte range ((first, last) as parsed from a
         single-range ``bytes=`` header; either side may be open) — only
         the chunks overlapping it are gathered, the partial-read
         capability chunk-granular manifests buy (the reference can only
         assemble whole files, StorageNode.java:399-461). Range
         satisfiability is resolved HERE, against the resolved manifest,
-        so exactly one clamp exists. Returns (manifest, data, start, end).
+        so exactly one clamp exists. Returns (manifest, parts, start,
+        end) where ``parts`` is the range payload as an ordered BUFFER
+        LIST (read-only views into the gathered chunks) — the HTTP layer
+        writes them to the socket one by one; nothing joins them
+        (docs/wire.md zero-copy discipline).
 
         The whole-file hash gate cannot apply to a partial read, so local
         chunk copies are digest-verified up front; a rotten one is
@@ -1679,11 +1696,15 @@ class StorageNodeServer:
         parts = []
         for c in wanted:
             b = by_digest[c.digest]
+            if not isinstance(b, memoryview):
+                # slice via a view: a range over large chunks must not
+                # copy each chunk's overlap (DFS006 copy discipline)
+                b = memoryview(b)
             lo = max(0, start - c.offset)
             hi = min(c.length, end - c.offset)
             parts.append(b[lo:hi])
         self.counters.inc("range_downloads")
-        return manifest, b"".join(parts), start, end
+        return manifest, parts, start, end
 
     async def _fetch_verified(self, manifest: Manifest, chunks: list,
                               strict: bool = True) -> dict[str, bytes]:
@@ -1883,26 +1904,24 @@ class StorageNodeServer:
 
         return manifest, gen()
 
-    async def download(self, file_id: str) -> tuple[Manifest, bytes]:
-        manifest = await self._resolve_manifest(file_id)
-
+    async def download(self, file_id: str) -> tuple[Manifest, bytearray]:
+        """Whole-file read for callers that want one bytes-like object.
+        Since round 10 this is a thin accumulator over
+        :meth:`download_stream` — ONE assembly path owns batching,
+        per-chunk verification, and the whole-file hash gate (the
+        streamed path's incremental hash + held-back final chunk is
+        exactly the reference's sha256(assembled) == fileId check,
+        StorageNode.java:453-458, surfaced before the last byte). The
+        pre-r10 implementation gathered every chunk into a dict and
+        joined it — two resident copies of the file plus a full-corpus
+        memcpy; this keeps ONE growing buffer (returned as a bytearray —
+        bytes-like for every comparison/hash/slice use) and no join."""
+        manifest, gen = await self.download_stream(file_id)
+        out = bytearray()
         with self.obs.span("download.gather", latency=True):
-            if self.serve.read_path_enabled:
-                # cache + single-flight front; the whole-file hash gate
-                # below still guards assembly exactly as before
-                by_digest = await self._fetch_verified(
-                    manifest, list(manifest.chunks))
-            else:
-                by_digest = await self._gather_chunks(manifest)
-        data = b"".join(by_digest[c.digest] for c in manifest.chunks)
-        # Whole-file integrity gate, exactly the reference's
-        # sha256(assembled) == fileId check (StorageNode.java:453-458) —
-        # hashed off the event loop (big files would stall other requests)
-        if await asyncio.to_thread(sha256_hex, data) != file_id:
-            raise DownloadError("File corrupted")
-        self.counters.inc("downloads")
-        self.counters.inc("download_bytes", len(data))
-        return manifest, data
+            async for part in gen:
+                out += part
+        return manifest, out
 
     # ------------------------------------------------------------------ #
     # listing (reference handleListFiles, StorageNode.java:364-393)

@@ -97,6 +97,55 @@ def make_sharded_step(mesh: Mesh, table: np.ndarray, mask: int):
     return jax.jit(shard_fn)
 
 
+def make_sharded_bitmap_step(mesh: Mesh, table: np.ndarray, mask: int):
+    """Carry-in Gear bitmap over the mesh — the INGEST-side sharded step
+    (round 10): ``fragmenter/cdc_sharded.py`` plugs it into the streaming
+    chunker as a ``bitmap_fn``, so ``stream.py`` feeds whole regions
+    through the mesh while greedy cut selection stays host-side — chunk
+    boundaries are byte-identical to the single-device path by
+    construction (the same bitmap, computed sharded).
+
+    Differs from :func:`make_sharded_step`'s bitmap in one way: the
+    stream's region-to-region 31-value halo enters as an explicit input
+    (``head``) consumed by the FIRST sp tile instead of zeros, so
+    consecutive regions of one stream chunk exactly like one long
+    buffer (zeros ≡ stream start, the old behavior).
+
+    step(data [B, S] u8 — B over dp, S tiled over sp,
+         head [B, HALO] u32 — per-row carry halo, replicated over sp)
+      -> bitmap [B, S] bool (same sharding as data)
+    """
+    table_j = jnp.asarray(table, dtype=jnp.uint32)
+    mask_j = jnp.uint32(mask)
+    sp_size = mesh.shape["sp"]
+
+    def local_step(data, head):
+        g_tail = jnp.take(table_j, data[:, -HALO:].astype(jnp.int32),
+                          axis=0)
+        prev_g = jax.lax.ppermute(
+            g_tail, "sp", [(i, i + 1) for i in range(sp_size - 1)])
+        # sp-rank 0's halo is the carry from the previous REGION of the
+        # stream, not the ring (which handed it nothing)
+        prev_g = jnp.where(jax.lax.axis_index("sp") == 0, head, prev_g)
+        return _rowwise_gear_bitmap(data, prev_g, table_j, mask_j)
+
+    shard_fn = _shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P("dp", "sp"), P("dp", None)),
+        out_specs=P("dp", "sp"),
+        check_vma=False,
+    )
+    return jax.jit(shard_fn)
+
+
+def shard_bitmap_inputs(mesh: Mesh, data: np.ndarray, head: np.ndarray):
+    """device_put the carry-bitmap step inputs with matching shardings."""
+    return (
+        jax.device_put(data, NamedSharding(mesh, P("dp", "sp"))),
+        jax.device_put(head, NamedSharding(mesh, P("dp", None))),
+    )
+
+
 def shard_inputs(mesh: Mesh, data: np.ndarray, words: np.ndarray,
                  nblocks: np.ndarray):
     """device_put the step inputs with the matching NamedShardings."""

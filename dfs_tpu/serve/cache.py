@@ -57,7 +57,10 @@ class ChunkCache:
 
     # ------------------------------------------------------------------ #
 
-    def get(self, digest: str) -> bytes | None:
+    def get(self, digest: str) -> memoryview | None:
+        """Hot hit → READ-ONLY memoryview of the cached payload (never a
+        copy: downstream range slicing / socket writes operate on views
+        of the one cached buffer — docs/wire.md buffer-ownership rules)."""
         with self._lock:
             node = self._map.get(digest)
             if node is None:
@@ -65,18 +68,23 @@ class ChunkCache:
                 return None
             node.visited = True       # lazy promotion: no list movement
             self.hits += 1
-            return node.data
+            return memoryview(node.data).toreadonly()
 
-    def put(self, digest: str, data: bytes) -> bool:
+    def put(self, digest: str, data) -> bool:
         """Insert verified bytes; returns False when already present or
         when the payload alone exceeds the whole budget (a chunk bigger
-        than the cache must not wipe it to still not fit)."""
+        than the cache must not wipe it to still not fit). The cache
+        OWNS its entries: a non-bytes payload (e.g. a memoryview slice
+        of a wire frame) is copied compactly here — caching a view would
+        pin the whole multi-MiB frame per cached chunk."""
         n = len(data)
         if n > self.budget:
             return False
         with self._lock:
             if digest in self._map:
                 return False
+            if not isinstance(data, bytes):
+                data = bytes(data)   # dfslint: ignore[DFS006] - ownership copy
             while self._bytes + n > self.budget:
                 self._evict_one()
             node = _Node(digest, data)

@@ -275,7 +275,8 @@ def check_digest_boundary(project: Project) -> Iterator[Finding]:
 # dataclasses in dfs_tpu/config.py whose every field must be settable
 # from the `serve` CLI (a field without a flag silently pins a
 # deployment to the default — the drift this rule exists to catch)
-_CLI_CLASSES = ("NodeConfig", "ServeConfig", "IngestConfig", "ObsConfig")
+_CLI_CLASSES = ("NodeConfig", "ServeConfig", "IngestConfig", "ObsConfig",
+                "FragmenterConfig")
 # config field -> /metrics key that surfaces it, per stats function.
 # "cas" carries cas_io_threads as its nested workers count
 # (store/aio.py stats()).
@@ -474,6 +475,67 @@ def check_config_drift(project: Project) -> Iterator[Finding]:
 
 
 # ------------------------------------------------------------------ #
+# DFS006 — copy discipline on the data plane
+# ------------------------------------------------------------------ #
+
+# the modules whose payload path is contractually zero-copy since r10
+# (docs/wire.md): chunk bytes travel as buffer lists / memoryview
+# slices from CAS read to socket write — a b"".join() or bytes() over
+# them reintroduces exactly the full-body memcpy the scatter-gather
+# wire exists to eliminate (WIRE_r10.json measures the cost)
+_COPY_PLANE = ("dfs_tpu/comm/", "dfs_tpu/serve/", "dfs_tpu/store/",
+               "dfs_tpu/node/runtime.py")
+
+
+def _on_copy_plane(rel: str) -> bool:
+    return any(rel.startswith(p) or f"/{p}" in rel for p in _COPY_PLANE)
+
+
+def check_copy_discipline(project: Project) -> Iterator[Finding]:
+    """Flag payload-copying idioms inside data-plane modules:
+    ``b"".join(...)`` (joins a buffer list into one body) and
+    ``bytes(x)`` over a non-constant (materializes a memoryview). Both
+    are sometimes legitimate — a deliberate ownership copy (the serve
+    cache), a small header decode — and those sites carry an inline
+    ``# dfslint: ignore[DFS006]`` with their justification; everything
+    else is a hot-path regression the r10 zero-copy work paid to
+    remove."""
+    for src in project.files:
+        if src.tree is None or not _on_copy_plane(src.rel):
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            what = detail = None
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"
+                    and isinstance(node.func.value, ast.Constant)
+                    and isinstance(node.func.value.value, bytes)
+                    and not node.func.value.value):
+                what = ('b"".join(...) assembles one contiguous body '
+                        "from buffers — a full payload memcpy; keep the "
+                        "buffer list (send_msg / resp_parts / "
+                        "writer.write per buffer take it as-is)")
+                detail = "join"
+            elif (isinstance(node.func, ast.Name)
+                  and node.func.id == "bytes" and len(node.args) == 1
+                  and not isinstance(node.args[0], ast.Constant)
+                  and not node.keywords):
+                what = ("bytes(...) over a buffer materializes a copy — "
+                        "pass the memoryview through (hashing, file "
+                        "writes, socket writes all take views); if the "
+                        "copy is a deliberate ownership transfer, "
+                        "annotate it")
+                detail = "bytes"
+            if what is None:
+                continue
+            yield Finding(
+                "DFS006", "error", src.rel, node.lineno, node.col_offset,
+                f"{what} (data-plane copy discipline, docs/wire.md)",
+                f"{src.qualname(node)}:{detail}")
+
+
+# ------------------------------------------------------------------ #
 # registry
 # ------------------------------------------------------------------ #
 
@@ -483,6 +545,7 @@ ALL_RULES = (
     ("DFS003", "lock discipline across sync/async", check_lock_discipline),
     ("DFS004", "digest outside utils/hashing + ops", check_digest_boundary),
     ("DFS005", "CLI/config//metrics drift", check_config_drift),
+    ("DFS006", "data-plane copy discipline", check_copy_discipline),
 )
 
 

@@ -306,6 +306,53 @@ def test_dfs005_unmapped_field_needs_table_entry(tmp_path):
 
 
 # ------------------------------------------------------------------ #
+# DFS006 — data-plane copy discipline
+# ------------------------------------------------------------------ #
+
+def test_dfs006_true_positives(tmp_path):
+    src = (
+        "def assemble(parts, mv):\n"
+        "    body = b''.join(parts)\n"
+        "    owned = bytes(mv)\n"
+        "    return body, owned\n")
+    found = lint(tmp_path / "a", {"dfs_tpu/comm/rpc.py": src})
+    assert rules_of(found) == ["DFS006", "DFS006"]
+    assert "join" in found[0].context and "bytes" in found[1].context
+    # node/runtime.py and serve/ are data plane too
+    found = lint(tmp_path / "b", {"dfs_tpu/node/runtime.py": src})
+    assert rules_of(found) == ["DFS006", "DFS006"]
+    found = lint(tmp_path / "c", {"dfs_tpu/serve/cache.py": src})
+    assert rules_of(found) == ["DFS006", "DFS006"]
+
+
+def test_dfs006_scoped_to_data_plane_modules(tmp_path):
+    """The same idioms OUTSIDE the data-plane modules are fine — cold
+    paths (CLI, fragmenter host walks, tests) may join freely."""
+    src = ("def f(parts, mv):\n"
+           "    return b''.join(parts), bytes(mv)\n")
+    assert lint(tmp_path / "a", {"dfs_tpu/cli/client.py": src}) == []
+    assert lint(tmp_path / "b", {"dfs_tpu/fragmenter/stream.py": src}) == []
+
+
+def test_dfs006_true_negatives(tmp_path):
+    # separators with content, str joins on non-empty separators,
+    # bytes() literals/empty constructors, and annotated ownership
+    # copies are all allowed
+    found = lint(tmp_path, {"dfs_tpu/comm/wire.py": (
+        "def ok(parts, n, data):\n"
+        "    a = b','.join(parts)\n"
+        "    b = bytes(8)\n"          # bytes(int) is an alloc, not a copy
+        "    c = bytes()\n"
+        "    d = ', '.join(str(p) for p in parts)\n"
+        "    e = bytes(data)  # dfslint: ignore[DFS006] - ownership copy\n"
+        "    f = ''.join(c for c in data)\n"  # str join copies no payload
+        "    return a, b, c, d, e, f\n")})
+    # bytes(8): the arg is a constant -> not flagged; bytes(data) is
+    # suppressed inline; everything else is out of pattern
+    assert found == []
+
+
+# ------------------------------------------------------------------ #
 # suppressions, baseline, walker, parse errors
 # ------------------------------------------------------------------ #
 

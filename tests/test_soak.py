@@ -59,9 +59,9 @@ def _lifecycle(tmp_path, rng, total: int, n_files: int) -> None:
 
             # 3. ranges from arbitrary nodes
             for fid, data in list(files.items())[:3]:
-                _, part, s, e = await nodes[3].download_range(
+                _, parts, s, e = await nodes[3].download_range(
                     fid, 1000, 50_000)
-                assert part == data[s:e]
+                assert b"".join(parts) == data[s:e]
 
             # 4. corrupt one chunk somewhere, scrub, repair
             fid0, data0 = next(iter(files.items()))

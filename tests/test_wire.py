@@ -1,0 +1,411 @@
+"""Wire-layer coverage for the round-10 zero-copy data plane:
+
+- framing fuzz — truncated prefix/header/body, bad magic, oversize
+  lengths, garbage JSON — every case must surface as :class:`WireError`
+  promptly (no hang, no partial-frame desync) on BOTH receive paths
+  (the stream-based ``read_msg`` and the BufferedProtocol connections);
+- vectored sends: a buffer-list body puts byte-identical frames on the
+  wire as the joined body it replaces;
+- pooled-connection recovery: after a server tears a connection down on
+  a malformed frame, the next RPC through the pool succeeds on a fresh
+  dial;
+- RPC byte accounting: /metrics per-peer bytes equal what the socket
+  actually carried — frame headers included — verified against a
+  byte-counting recorded exchange.
+- bench smoke: ``bench_wire.py --tiny`` runs both wire arms + the
+  real-path identity gate in seconds and emits the WIRE_r10.json schema.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import struct
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from dfs_tpu.comm import wire
+from dfs_tpu.comm.rpc import InternalClient, RpcRemoteError
+from dfs_tpu.comm.wire import (MAGIC, FrameConnection, FrameServerProtocol,
+                               WireError, buffers_nbytes, encode_frame,
+                               frame_size, pack_chunks, read_msg, send_msg,
+                               unpack_chunks)
+from dfs_tpu.config import PeerAddr
+
+_PREFIX = struct.Struct(">IIQ")
+
+
+def feed_reader(data: bytes) -> asyncio.StreamReader:
+    r = asyncio.StreamReader()
+    r.feed_data(data)
+    r.feed_eof()
+    return r
+
+
+def frame_bytes(header: dict, body: bytes = b"") -> bytes:
+    head, bufs, _ = encode_frame(header, body)
+    return head + b"".join(bytes(b) for b in bufs)
+
+
+# ------------------------------------------------------------------ #
+# read_msg fuzz (stream path)
+# ------------------------------------------------------------------ #
+
+GOOD = frame_bytes({"op": "health"}, b"payload")
+
+BAD_FRAMES = [
+    ("truncated prefix", GOOD[:7]),
+    ("truncated header", GOOD[:_PREFIX.size + 3]),
+    ("truncated body", GOOD[:-3]),
+    ("bad magic", b"\x00\x00\x00\x00" + GOOD[4:]),
+    ("oversize hdr_len",
+     _PREFIX.pack(MAGIC, wire.MAX_HEADER + 1, 0) + b"x"),
+    ("oversize body_len",
+     _PREFIX.pack(MAGIC, 2, wire.MAX_BODY + 1) + b"{}"),
+    ("garbage json header",
+     _PREFIX.pack(MAGIC, 9, 0) + b"not-json!"),
+    ("non-object json header",
+     _PREFIX.pack(MAGIC, 4, 0) + b"1234"),
+    ("empty frame", _PREFIX.pack(MAGIC, 0, 0)),
+]
+
+
+@pytest.mark.parametrize("name,raw", BAD_FRAMES, ids=[n for n, _ in BAD_FRAMES])
+def test_read_msg_rejects_malformed(name, raw):
+    async def run():
+        with pytest.raises(WireError):
+            await read_msg(feed_reader(raw))
+
+    asyncio.run(run())
+
+
+def test_read_msg_roundtrip_and_trailing_frames():
+    async def run():
+        r = feed_reader(GOOD + GOOD)
+        for _ in range(2):      # framing must resynchronize exactly
+            hdr, body = await read_msg(r)
+            assert hdr == {"op": "health"} and body == b"payload"
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------------------ #
+# FrameConnection / FrameServerProtocol fuzz (zero-copy path)
+# ------------------------------------------------------------------ #
+
+async def _echo_server():
+    """Frame server echoing {'ok': True, 'echo': op} + the body back."""
+    async def handler(conn, header, body, nbytes):
+        conn.send_frame({"ok": True, "echo": header.get("op")}, body)
+        await conn.drain()
+
+    loop = asyncio.get_running_loop()
+    srv = await loop.create_server(
+        lambda: FrameServerProtocol(handler), "127.0.0.1", 0)
+    return srv, srv.sockets[0].getsockname()[1]
+
+
+@pytest.mark.parametrize("name,raw", BAD_FRAMES[3:],
+                         ids=[n for n, _ in BAD_FRAMES[3:]])
+def test_frame_server_drops_malformed_promptly(name, raw):
+    """Complete-but-malformed frames (the truncated ones just look like
+    a slow sender until EOF): the server must close the connection —
+    observed as EOF within the test timeout, never a hang."""
+    async def run():
+        srv, port = await _echo_server()
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            writer.write(raw)
+            await writer.drain()
+            try:
+                got = await asyncio.wait_for(reader.read(), timeout=5)
+                assert got == b""   # no reply, prompt close
+            except ConnectionResetError:
+                pass   # RST (unread garbage pending) is equally prompt
+            writer.close()
+        finally:
+            srv.close()
+            await srv.wait_closed()
+
+    asyncio.run(run())
+
+
+def test_frame_connection_rejects_malformed_reply():
+    """A server answering garbage must fail the in-flight reply() with
+    WireError promptly — and mark the connection unusable."""
+    crafted = _PREFIX.pack(MAGIC, 9, 0) + b"not-json!"
+
+    async def run():
+        async def bad_server(reader, writer):
+            await read_msg(reader)
+            writer.write(crafted)
+            await writer.drain()
+
+        srv = await asyncio.start_server(bad_server, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        try:
+            conn = await FrameConnection.connect("127.0.0.1", port)
+            await conn.send({"op": "x"})
+            with pytest.raises(WireError):
+                await asyncio.wait_for(conn.reply(), timeout=5)
+            assert conn.closed
+        finally:
+            srv.close()
+            await srv.wait_closed()
+
+    asyncio.run(run())
+
+
+def test_frame_connection_eof_mid_frame():
+    async def run():
+        async def dying_server(reader, writer):
+            await read_msg(reader)
+            # half a reply, then hang up: client must see WireError
+            writer.write(frame_bytes({"ok": True}, b"x" * 64)[:20])
+            await writer.drain()
+            writer.close()
+
+        srv = await asyncio.start_server(dying_server, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        try:
+            conn = await FrameConnection.connect("127.0.0.1", port)
+            await conn.send({"op": "x"})
+            with pytest.raises((WireError, ConnectionError)):
+                await asyncio.wait_for(conn.reply(), timeout=5)
+        finally:
+            srv.close()
+            await srv.wait_closed()
+
+    asyncio.run(run())
+
+
+def test_frame_roundtrip_zero_copy_views():
+    """End to end over the BufferedProtocol pair: scatter-gather body
+    out, ONE frame buffer back, unpack_chunks returning read-only views
+    of it."""
+    chunks = [("d1" * 32, b"a" * 1000), ("d2" * 32, b"b" * 500)]
+
+    async def run():
+        srv, port = await _echo_server()
+        try:
+            conn = await FrameConnection.connect("127.0.0.1", port)
+            table, bufs = pack_chunks(chunks)
+            await conn.send({"op": "put", "chunks": table}, bufs)
+            resp, body, nrecv = await conn.reply()
+            assert resp["ok"] and resp["echo"] == "put"
+            assert isinstance(body, memoryview) and body.readonly
+            out = unpack_chunks(table, body)
+            assert [(d, bytes(b)) for d, b in out] \
+                == [(d, bytes(b)) for d, b in chunks]
+            assert all(isinstance(b, memoryview) and b.readonly
+                       for _, b in out)
+            conn.close()
+        finally:
+            srv.close()
+            await srv.wait_closed()
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------------------ #
+# vectored sends == joined sends, byte for byte
+# ------------------------------------------------------------------ #
+
+def test_vectored_body_is_wire_identical_to_joined():
+    payloads = [b"abc", b"", bytearray(b"defg"), memoryview(b"hi")]
+    joined = b"abcdefghi"
+
+    async def run():
+        got: list[bytes] = []
+        done = asyncio.Event()
+
+        async def sink(reader, writer):
+            got.append(await reader.read())
+            done.set()
+
+        srv = await asyncio.start_server(sink, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        for body in (payloads, joined):
+            _, writer = await asyncio.open_connection("127.0.0.1", port)
+            n = await send_msg(writer, {"op": "x"}, body)
+            assert n == frame_size({"op": "x"}, len(joined))
+            writer.close()
+            await done.wait()
+            done.clear()
+        srv.close()
+        await srv.wait_closed()
+        assert got[0] == got[1]
+        assert got[0].endswith(joined)
+
+    asyncio.run(run())
+
+
+def test_pack_chunks_returns_buffers_not_joined():
+    table, bufs = pack_chunks([("d" * 64, b"xx"), ("e" * 64, b"yyy")])
+    assert [e["length"] for e in table] == [2, 3]
+    assert bufs == [b"xx", b"yyy"]          # the caller's own objects
+    assert buffers_nbytes(bufs) == 5
+
+
+@pytest.mark.parametrize("table", [
+    [{"length": "abc", "digest": "d" * 64}],   # non-numeric length
+    [{"digest": "d" * 64}],                    # missing length
+    [{"length": 4}],                           # missing digest
+    ["not-a-dict"],                            # entry is not a mapping
+    [None],
+], ids=["bad-length", "no-length", "no-digest", "list-entry", "none-entry"])
+def test_unpack_chunks_malformed_table_raises_wire_error(table):
+    """A byzantine peer's chunk table must surface as WireError — the
+    recoverable class callers catch to fall back to other replicas —
+    never a raw ValueError/TypeError/KeyError."""
+    with pytest.raises(WireError):
+        unpack_chunks(table, b"data")
+
+
+# ------------------------------------------------------------------ #
+# pooled-connection recovery after a desync
+# ------------------------------------------------------------------ #
+
+def test_pool_recovers_after_malformed_frame_teardown():
+    """Force a pooled connection to die on a malformed frame mid-use;
+    the NEXT call through the client must succeed (fresh dial), and an
+    application-level error must still surface as RpcRemoteError (live
+    peer) — the desync never wedges the pool."""
+    async def run():
+        calls = {"n": 0}
+
+        async def handler(conn, header, body, nbytes):
+            calls["n"] += 1
+            if header.get("op") == "boom":
+                conn.send_frame({"ok": False, "error": "nope"})
+            else:
+                conn.send_frame({"ok": True, "n": calls["n"]})
+            await conn.drain()
+
+        loop = asyncio.get_running_loop()
+        srv = await loop.create_server(
+            lambda: FrameServerProtocol(handler), "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        peer = PeerAddr(node_id=9, host="127.0.0.1", port=0,
+                        internal_port=port)
+        client = InternalClient(retries=2)
+        try:
+            resp, _ = await client.call(peer, {"op": "hi"})
+            assert resp["ok"]
+            # corrupt the POOLED connection from under the client: the
+            # server kills it on the bad magic; the client's next call
+            # must transparently re-dial
+            conn = client._checkout(peer)
+            assert conn is not None
+            conn._transport.write(b"GARBAGE-NOT-A-FRAME!")
+            await asyncio.sleep(0.05)
+            client._checkin(peer, conn)
+            resp, _ = await client.call(peer, {"op": "hi2"})
+            assert resp["ok"]
+            with pytest.raises(RpcRemoteError):
+                await client.call(peer, {"op": "boom"})
+            # ... and the pool is STILL usable after the app error
+            resp, _ = await client.call(peer, {"op": "hi3"})
+            assert resp["ok"]
+        finally:
+            client.close()
+            srv.close()
+            await srv.wait_closed()
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------------------ #
+# RPC byte accounting vs a recorded exchange
+# ------------------------------------------------------------------ #
+
+def test_rpc_client_bytes_match_socket_exactly():
+    """The client's per-peer RPC table must count FRAME bytes (prefix +
+    header + body, both directions) — compared against a server that
+    counts the raw bytes it actually read/wrote on the socket."""
+    from dfs_tpu.config import ObsConfig
+    from dfs_tpu.obs import Observability
+
+    wire_in: list[int] = []
+    wire_out: list[int] = []
+
+    async def run():
+        async def counting_server(reader, writer):
+            try:
+                while True:
+                    prefix = await reader.readexactly(_PREFIX.size)
+                    _, hl, bl = _PREFIX.unpack(prefix)
+                    await reader.readexactly(hl + bl)
+                    wire_in.append(_PREFIX.size + hl + bl)
+                    wire_out.append(await send_msg(
+                        writer, {"ok": True, "digests": ["d" * 64]}))
+            except (asyncio.IncompleteReadError, ConnectionError):
+                pass
+            finally:
+                writer.close()
+
+        srv = await asyncio.start_server(counting_server, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        peer = PeerAddr(node_id=7, host="127.0.0.1", port=0,
+                        internal_port=port)
+        obs = Observability(ObsConfig(), node_id=1)
+        client = InternalClient(obs=obs)
+        try:
+            # a store with a real scatter-gather payload + a bare call
+            await client.store_chunks(peer, "f" * 64,
+                                      [("a" * 64, b"x" * 1000),
+                                       ("b" * 64, memoryview(b"y" * 37))])
+            await client.call(peer, {"op": "health"})
+        finally:
+            client.close()
+            srv.close()
+            await srv.wait_closed()
+
+        snap = obs.rpc_client.snapshot()
+        total_out = sum(v["bytesOut"] for v in snap.values())
+        total_in = sum(v["bytesIn"] for v in snap.values())
+        assert total_out == sum(wire_in), snap
+        assert total_in == sum(wire_out), snap
+        # sanity: headers ARE included — bytesOut exceeds the payloads
+        assert snap["7:store_chunks"]["bytesOut"] > 1037
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------------------ #
+# tier-1 smoke: bench_wire --tiny exercises both arms + the identity
+# gate on the real storage path and emits the WIRE_r10.json schema
+# ------------------------------------------------------------------ #
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_bench_wire_tiny(tmp_path):
+    out_path = tmp_path / "WIRE_tiny.json"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO)}
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench_wire.py"),
+         "--tiny", "--out", str(out_path)],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    art = json.loads(out_path.read_text())
+    # schema: the keys WIRE_r10.json (full mode) commits to
+    for key in ("metric", "round", "mode", "wire", "cdc",
+                "byte_identical", "ok"):
+        assert key in art, f"artifact missing {key!r}"
+    assert art["metric"] == "zero_copy_data_plane" and art["mode"] == "tiny"
+    assert art["byte_identical"] is True and art["ok"] is True
+    w = art["wire"]
+    assert len(w["chunk_sizes"]) == len(w["joined_gibps"]) \
+        == len(w["sg_gibps"]) == len(w["speedup"])
+    assert all(r > 0 for r in w["joined_gibps"] + w["sg_gibps"])
+    # perf is NOT gated in tiny mode (CI hosts stall unpredictably; the
+    # committed WIRE_r10.json carries the >=1.3x claim) — but the
+    # speedup column must at least be well-formed
+    assert w["speedup_64k"] == w["speedup"][0]

@@ -1,29 +1,37 @@
-"""Observability benchmark -> OBS_r09.json: stitched cross-node tracing
-evidence + the always-on tracing overhead bound.
+"""Observability benchmark -> OBS2_r11.json: the diagnosis plane's
+acceptance evidence (journal + sentinels + tail-kept traces + doctor).
 
-Two phases, in-process nodes, CPU CDC engine (tracing is backend- and
-transport-agnostic):
+Three phases, in-process nodes, CPU CDC engine:
 
-1. stitched trace — a 3-node cluster, upload at node 1 and download at
-   node 3, both requests tagged with ONE client-minted trace id via the
-   ``X-Dfs-Trace`` header. ``GET /trace?traceId=…`` on node 1 must
-   return a single connected trace: spans from >= 2 nodes, client-facing
-   HTTP spans present, and >= 1 CROSS-NODE parent link (a span whose
-   parent span lives on a different node — the rpc.* -> peer.* edge the
-   wire ``trace`` field exists to create).
-2. tracing overhead — cached hot reads (SERVE_r06 phase-2b methodology:
+1. overhead — cached hot reads (SERVE_r06 phase-2b methodology:
    ``download_range`` on a warm SIEVE cache, ``readers`` concurrent
    whole-file reads x rounds), each read entered through a request span
-   exactly like the HTTP layer does. Arms: default ObsConfig (ring on)
-   vs ``trace_ring=0`` (tracing fully off), alternated over several
-   repeats, best-of each arm compared. Acceptance: tracing adds <= 2%.
+   exactly like the HTTP layer does. Arms: EVERYTHING ON (default
+   ObsConfig: trace ring, tail retention, flight-recorder journal,
+   sentinels) vs EVERYTHING OFF (trace_ring=0, tail_keep=0,
+   journal_bytes=0, sentinel_interval_s=0), alternated; the gated
+   number is the median of per-repeat PAIRED overheads (adjacent arms
+   share host conditions — see overhead_phase). Acceptance: the
+   diagnosis plane adds <= 2%.
+2. doctor — a 3-node cluster with node 3's dispatch delayed 1s per
+   op (dominating the real per-call work); after traffic,
+   ``GET /doctor`` on node 2 must name ``slow_peer`` with exactly
+   node 3 as the offender.
+3. tailkeep — a forced-slow download (peer dispatch lag makes the
+   ``http./download`` request span exceed ``slow_span_s``): its trace
+   id must (a) appear as an OpenMetrics exemplar on the download
+   latency histogram, and (b) still be retrievable via ``/trace`` after
+   enough ordinary traffic churned an ordinary trace out of the
+   (deliberately small) span ring.
 
-Usage: python bench_obs.py [file_bytes] [readers]
-Writes OBS_r09.json and prints it.
+Usage: python bench_obs.py [file_bytes] [readers] [--tiny] [--out PATH]
+Writes OBS2_r11.json (or --out) and prints it. OBS_r09.json (the r09
+tracing evidence this bench's earlier life produced) stays committed.
 """
 
 from __future__ import annotations
 
+import argparse
 import asyncio
 import json
 import socket
@@ -39,8 +47,11 @@ from dfs_tpu.config import (CDCParams, ClusterConfig, NodeConfig,
 from dfs_tpu.node.runtime import StorageNodeServer
 from dfs_tpu.obs import new_span_id, new_trace_id
 
-ART = "OBS_r09.json"
+ART = "OBS2_r11.json"
 CDC = CDCParams(min_size=2048, avg_size=8192, max_size=65536)
+
+OBS_ALL_OFF = ObsConfig(trace_ring=0, tail_keep=0, journal_bytes=0,
+                        sentinel_interval_s=0)
 
 
 def log(msg: str) -> None:
@@ -59,69 +70,47 @@ def _free_ports(n: int) -> list[int]:
     return ports
 
 
-async def stitched_trace_phase(tmp: Path, data: bytes) -> dict:
-    ports = _free_ports(6)
+def _mk_cluster(n: int, rf: int) -> ClusterConfig:
+    ports = _free_ports(2 * n)
     peers = tuple(PeerAddr(node_id=i + 1, host="127.0.0.1",
                            port=ports[2 * i],
                            internal_port=ports[2 * i + 1])
-                  for i in range(3))
-    cluster = ClusterConfig(peers=peers, replication_factor=2)
-    nodes = []
-    for p in peers:
+                  for i in range(n))
+    return ClusterConfig(peers=peers, replication_factor=rf)
+
+
+async def _start(cluster: ClusterConfig, root: Path,
+                 **cfg_kw) -> dict[int, StorageNodeServer]:
+    nodes = {}
+    for p in cluster.peers:
         cfg = NodeConfig(node_id=p.node_id, cluster=cluster,
-                         data_root=tmp / "cluster", fragmenter="cdc",
-                         cdc=CDC, health_probe_s=0)
+                         data_root=root, fragmenter="cdc", cdc=CDC,
+                         health_probe_s=0, **cfg_kw)
         n = StorageNodeServer(cfg)
         await n.start()
-        nodes.append(n)
-    try:
-        tid = new_trace_id()
-        hdr = {"X-Dfs-Trace": f"{tid}-{new_span_id()}"}
+        nodes[p.node_id] = n
+    return nodes
 
-        def req(port: int, method: str, path: str,
-                body: bytes | None = None) -> bytes:
-            r = urllib.request.Request(
-                f"http://127.0.0.1:{port}{path}", data=body,
-                method=method, headers=hdr)
-            with urllib.request.urlopen(r, timeout=120) as resp:
-                return resp.read()
 
-        up = json.loads(await asyncio.to_thread(
-            req, peers[0].port, "POST", "/upload?name=obs.bin", data))
-        got = await asyncio.to_thread(
-            req, peers[2].port, "GET", f"/download?fileId={up['fileId']}")
-        assert got == data, "download not byte-identical"
-        trace = json.loads((await asyncio.to_thread(
-            req, peers[0].port, "GET",
-            f"/trace?traceId={tid}")).decode())
-        spans = trace["spans"]
-        ids = {s["s"]: s["node"] for s in spans}
-        cross = sum(1 for s in spans
-                    if s.get("p") in ids and ids[s["p"]] != s["node"])
-        names = {s["name"] for s in spans}
-        return {
-            "trace_id": tid,
-            "spans": len(spans),
-            "nodes_in_trace": sorted({s["node"] for s in spans}),
-            "cross_node_links": cross,
-            "http_spans": sorted(n for n in names if n.startswith("http.")),
-            "peer_spans": sorted(n for n in names if n.startswith("peer.")),
-            "stitched": (len({s["node"] for s in spans}) >= 2
-                         and cross >= 1
-                         and "http./upload" in names
-                         and "http./download" in names),
-        }
-    finally:
-        for n in nodes:
-            await n.stop()
+def _req(port: int, method: str, path: str, body: bytes | None = None,
+         headers: dict | None = None) -> bytes:
+    r = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                               data=body, method=method,
+                               headers=headers or {})
+    with urllib.request.urlopen(r, timeout=120) as resp:
+        return resp.read()
 
+
+# ------------------------------------------------------------------ #
+# phase 1: everything-on overhead on cached hot reads
+# ------------------------------------------------------------------ #
 
 async def _hot_read_gibps(node: StorageNodeServer, file_id: str,
                           size: int, readers: int, rounds: int) -> float:
     """Aggregate GiB/s of concurrent cached whole-file range reads, each
     entered through a request span exactly like the HTTP layer."""
     async def read_once() -> None:
-        with node.obs.request_span("http./download"):
+        with node.obs.request_span("http./download", latency=True):
             _, parts, _, _ = await node.download_range(file_id, 0, size - 1)
         assert sum(len(p) for p in parts) == size
 
@@ -134,73 +123,244 @@ async def _hot_read_gibps(node: StorageNodeServer, file_id: str,
 
 async def overhead_phase(tmp: Path, data: bytes, readers: int,
                          rounds: int, repeats: int) -> dict:
-    """Best-of alternating arms: tracing on (default ObsConfig) vs
-    trace_ring=0, identical node/workload otherwise."""
-    results: dict[str, list[float]] = {"on": [], "off": []}
+    """Paired INTERLEAVED arms: the full diagnosis plane (default
+    ObsConfig) vs everything off, identical node/workload otherwise.
+
+    Both arms' nodes live in the SAME process with their caches warmed
+    before any measurement, and repeats alternate arm order — a fresh
+    process per arm measures mostly page-cache and scheduler luck on a
+    small container (one such run showed a 23% swing BETWEEN two runs
+    of the same arm), while interleaved same-process sampling isolates
+    the per-read cost the gate is actually about."""
     serve = ServeConfig(cache_bytes=max(256 * 2**20, 4 * len(data)))
-    for arm, obs_cfg in (("off", ObsConfig(trace_ring=0)),
-                         ("on", ObsConfig())):
-        ports = _free_ports(2)
-        cluster = ClusterConfig(peers=(PeerAddr(
-            node_id=1, host="127.0.0.1", port=ports[0],
-            internal_port=ports[1]),), replication_factor=1)
-        cfg = NodeConfig(node_id=1, cluster=cluster,
-                         data_root=tmp / f"hot_{arm}", fragmenter="cdc",
-                         cdc=CDC, serve=serve, obs=obs_cfg,
-                         health_probe_s=0)
-        node = StorageNodeServer(cfg)
-        await node.start()
-        try:
-            m, _ = await node.upload(data, "hot.bin")
-            size = len(data)
-            await _hot_read_gibps(node, m.file_id, size, 4, 1)  # warm
-            for _ in range(repeats):
+    size = len(data)
+    arms: dict[str, StorageNodeServer] = {}
+    files: dict[str, str] = {}
+    results: dict[str, list[float]] = {"on": [], "off": []}
+    try:
+        for arm, obs_cfg in (("off", OBS_ALL_OFF), ("on", ObsConfig())):
+            cluster = _mk_cluster(1, rf=1)
+            nodes = await _start(cluster, tmp / f"hot_{arm}",
+                                 serve=serve, obs=obs_cfg)
+            arms[arm] = nodes[1]
+            m, _ = await nodes[1].upload(data, "hot.bin")
+            files[arm] = m.file_id
+            await _hot_read_gibps(nodes[1], m.file_id, size, 4, 1)  # warm
+        for rep in range(repeats):
+            order = ("off", "on") if rep % 2 == 0 else ("on", "off")
+            for arm in order:
                 results[arm].append(await _hot_read_gibps(
-                    node, m.file_id, size, readers, rounds))
-        finally:
+                    arms[arm], files[arm], size, readers, rounds))
+    finally:
+        for node in arms.values():
             await node.stop()
-        log(f"phase 2 arm={arm}: " + ", ".join(
+    for arm in ("off", "on"):
+        log(f"phase 1 arm={arm}: " + ", ".join(
             f"{x:.3f}" for x in results[arm]) + " GiB/s")
     on, off = max(results["on"]), max(results["off"])
-    overhead_pct = (off - on) / off * 100.0
+    best_of_pct = (off - on) / off * 100.0
+    # The gated estimator is the MEDIAN of per-repeat paired overheads:
+    # the two arms of one repeat run back to back, so each pair shares
+    # its moment's host conditions and pairing cancels the minutes-scale
+    # load drift that best-of — comparing two lucky draws from
+    # DIFFERENT repeats — cannot (per-sample swing on this shared
+    # 1-core host is ±20%; bench.py's paired-slope median is the same
+    # discipline). best_of_pct and the raw samples stay in the artifact
+    # so the number can be recomputed from its own evidence.
+    paired = sorted((o - n) / o * 100.0
+                    for o, n in zip(results["off"], results["on"]))
+    mid = len(paired) // 2
+    overhead_pct = paired[mid] if len(paired) % 2 \
+        else (paired[mid - 1] + paired[mid]) / 2.0
     return {"readers": readers, "rounds": rounds, "repeats": repeats,
-            "traced_gibps": round(on, 4),
-            "untraced_gibps": round(off, 4),
+            "diagnosis_on_gibps": round(on, 4),
+            "diagnosis_off_gibps": round(off, 4),
+            "samples_gibps": {arm: [round(x, 4) for x in results[arm]]
+                              for arm in ("off", "on")},
+            "best_of_pct": round(best_of_pct, 3),
             "overhead_pct": round(overhead_pct, 3),
             "within_2pct": overhead_pct <= 2.0}
 
 
-async def run(total: int, readers: int, tmp: Path) -> dict:
-    rng = np.random.default_rng(9)
+# ------------------------------------------------------------------ #
+# phase 2: the doctor names an injected slow peer
+# ------------------------------------------------------------------ #
+
+async def doctor_phase(tmp: Path, data: bytes, uploads: int) -> dict:
+    cluster = _mk_cluster(3, rf=3)
+    nodes = await _start(cluster, tmp / "doctor")
+    try:
+        real_dispatch = nodes[3]._dispatch
+
+        # 1s, not something subtler: the lag must dominate real
+        # per-call work (hash-echo verify, cold-start JIT — observed at
+        # 150ms+ on a loaded host) or the slow peer hides under the 3x
+        # rule threshold and the gate tests the weather
+        async def laggy(header, body):
+            await asyncio.sleep(1.0)
+            return await real_dispatch(header, body)
+
+        nodes[3]._dispatch = laggy
+        for i in range(uploads):
+            await nodes[1].upload(data + bytes([i % 256]), f"d{i}.bin")
+        rep = json.loads((await asyncio.to_thread(
+            _req, cluster.peers[1].port, "GET", "/doctor")).decode())
+        slow = [f for f in rep["findings"] if f["rule"] == "slow_peer"]
+        return {"injected_slow_peer": 3, "uploads": uploads,
+                "peers_queried": len(rep["nodes"]),
+                "findings": rep["findings"],
+                "slow_peer_findings": slow,
+                "named_correctly": bool(slow and slow[0]["peers"] == [3]
+                                        and len(slow) == 1)}
+    finally:
+        for n in nodes.values():
+            await n.stop()
+
+
+# ------------------------------------------------------------------ #
+# phase 3: tail retention + exemplars on a forced-slow download
+# ------------------------------------------------------------------ #
+
+async def tailkeep_phase(tmp: Path, data: bytes, churn: int) -> dict:
+    # small ring so ordinary churn provably evicts; slow_span_s well
+    # under the injected lag so the download pins
+    obs_cfg = ObsConfig(trace_ring=64, slow_span_s=0.2)
+    cluster = _mk_cluster(2, rf=2)
+    nodes = await _start(cluster, tmp / "tail", obs=obs_cfg)
+    try:
+        m, _ = await nodes[1].upload(data, "slow.bin")
+
+        # an ORDINARY (fast) download first: its trace should NOT
+        # survive the churn — the control arm of tail retention
+        port1 = cluster.peers[0].port
+        ordinary_tid = new_trace_id()
+        hdr = {"X-Dfs-Trace": f"{ordinary_tid}-{new_span_id()}"}
+        await asyncio.to_thread(_req, port1, "GET",
+                                f"/download?fileId={m.file_id}", None, hdr)
+
+        # now force a SLOW download: delete node 1's local copies of the
+        # file's FIRST chunks (the head of the stream, covered by the
+        # request span) and lag node 2's dispatch — serving the request
+        # now requires peer fetches that push http./download far past
+        # slow_span_s
+        slow_tid = new_trace_id()
+        real_dispatch2 = nodes[2]._dispatch
+
+        async def laggy2(header, body):
+            await asyncio.sleep(0.4)
+            return await real_dispatch2(header, body)
+
+        nodes[2]._dispatch = laggy2
+        all_digests = m.digests()
+        for d in all_digests[: max(1, len(all_digests) // 4)]:
+            nodes[1].store.chunks.delete(d)
+        hdr_slow = {"X-Dfs-Trace": f"{slow_tid}-{new_span_id()}"}
+        got = await asyncio.to_thread(
+            _req, port1, "GET", f"/download?fileId={m.file_id}", None,
+            hdr_slow)
+        assert got == data, "forced-slow download not byte-identical"
+        nodes[2]._dispatch = real_dispatch2
+
+        # churn: ordinary traffic far beyond the 64-slot ring
+        for _ in range(churn):
+            await asyncio.to_thread(_req, port1, "GET", "/status")
+
+        ordinary = json.loads((await asyncio.to_thread(
+            _req, port1, "GET",
+            f"/trace?traceId={ordinary_tid}&cluster=0")).decode())
+        kept = json.loads((await asyncio.to_thread(
+            _req, port1, "GET",
+            f"/trace?traceId={slow_tid}")).decode())
+        prom = (await asyncio.to_thread(
+            _req, port1, "GET", "/metrics?format=prom")).decode()
+        exemplar_hit = any(
+            f'trace_id="{slow_tid}"' in line
+            for line in prom.splitlines()
+            if line.startswith("dfs_latency_seconds_bucket")
+            and 'name="http./download"' in line)
+        kept_names = sorted({s["name"] for s in kept["spans"]})
+        return {
+            "ring": obs_cfg.trace_ring, "churn_requests": churn,
+            "slow_trace_id": slow_tid,
+            "ordinary_trace_evicted": ordinary["spans"] == [],
+            "slow_trace_spans_after_churn": len(kept["spans"]),
+            "slow_trace_span_names": kept_names,
+            "exemplar_on_download_histogram": exemplar_hit,
+            "retained": bool(kept["spans"]
+                             and "http./download" in kept_names),
+        }
+    finally:
+        for n in nodes.values():
+            await n.stop()
+
+
+async def run(total: int, readers: int, tmp: Path, tiny: bool) -> dict:
+    rng = np.random.default_rng(11)
     data = rng.integers(0, 256, size=total, dtype=np.uint8).tobytes()
-    out: dict = {"metric": "obs_trace_overhead", "round": 9,
+    out: dict = {"metric": "obs_diagnosis_plane", "round": 11,
                  "workload": {"file_bytes": total, "readers": readers,
+                              "tiny": tiny,
                               "cdc": {"min": CDC.min_size,
                                       "avg": CDC.avg_size,
                                       "max": CDC.max_size}}}
-    out["stitch"] = await stitched_trace_phase(tmp, data[:4 * 2**20])
-    log(f"phase 1: {out['stitch']['spans']} spans across nodes "
-        f"{out['stitch']['nodes_in_trace']}, "
-        f"{out['stitch']['cross_node_links']} cross-node links")
-    out["overhead"] = await overhead_phase(tmp, data, readers,
-                                           rounds=3, repeats=3)
-    log(f"phase 2: traced {out['overhead']['traced_gibps']} vs untraced "
-        f"{out['overhead']['untraced_gibps']} GiB/s "
+    out["overhead"] = await overhead_phase(
+        tmp, data, readers, rounds=1 if tiny else 12,
+        repeats=2 if tiny else 9)
+    log(f"phase 1: on {out['overhead']['diagnosis_on_gibps']} vs off "
+        f"{out['overhead']['diagnosis_off_gibps']} GiB/s "
         f"({out['overhead']['overhead_pct']}% overhead)")
-    out["ok"] = bool(out["stitch"]["stitched"]
-                     and out["overhead"]["within_2pct"])
+    out["doctor"] = await doctor_phase(tmp, data[:30_000],
+                                       uploads=1 if tiny else 2)
+    log(f"phase 2: slow_peer named_correctly="
+        f"{out['doctor']['named_correctly']} "
+        f"({len(out['doctor']['findings'])} finding(s))")
+    # churn must exceed the phase's 64-slot ring with margin, or nothing
+    # ordinary is evicted and retention proves nothing
+    out["tailkeep"] = await tailkeep_phase(tmp, data[:256 * 1024],
+                                           churn=150)
+    log(f"phase 3: retained={out['tailkeep']['retained']} "
+        f"exemplar={out['tailkeep']['exemplar_on_download_histogram']} "
+        f"ordinary_evicted={out['tailkeep']['ordinary_trace_evicted']}")
+    # --tiny exercises the phases + schema as a CI smoke; the ≤2%
+    # overhead bound is the FULL run's gate (the committed artifact) —
+    # at tiny scale (2 repeats, 1 round) arm noise on a small host
+    # swings past the bound in both directions, so gating it there
+    # would only test the weather
+    overhead_ok = tiny or out["overhead"]["within_2pct"]
+    out["ok"] = bool(overhead_ok
+                     and out["doctor"]["named_correctly"]
+                     and out["tailkeep"]["retained"]
+                     and out["tailkeep"]["exemplar_on_download_histogram"]
+                     and out["tailkeep"]["ordinary_trace_evicted"])
     return out
 
 
-def main() -> int:
-    total = int(sys.argv[1]) if len(sys.argv) > 1 else 32 * 2**20
-    readers = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("file_bytes", nargs="?", type=int, default=None,
+                    help="hot-file size in bytes "
+                         "(default: 32 MiB, 2 MiB with --tiny)")
+    ap.add_argument("readers", nargs="?", type=int, default=None,
+                    help="concurrent readers (default: 16, 4 with --tiny)")
+    ap.add_argument("--tiny", action="store_true",
+                    help="tier-1 smoke mode: seconds, doctor+tailkeep "
+                         "gated, overhead reported but not gated")
+    ap.add_argument("--out", default=None,
+                    help=f"artifact path (default: {ART} next to this "
+                         "script)")
+    args = ap.parse_args(argv)
+    tiny = args.tiny
+    out_path = Path(args.out) if args.out \
+        else Path(__file__).parent / ART
+    total = args.file_bytes if args.file_bytes is not None \
+        else (2 * 2**20 if tiny else 32 * 2**20)
+    readers = args.readers if args.readers is not None \
+        else (4 if tiny else 16)
     import tempfile
 
     with tempfile.TemporaryDirectory(prefix="bench_obs_") as tmp:
-        out = asyncio.run(run(total, readers, Path(tmp)))
-    Path(__file__).parent.joinpath(ART).write_text(
-        json.dumps(out, indent=2) + "\n")
+        out = asyncio.run(run(total, readers, Path(tmp), tiny))
+    out_path.write_text(json.dumps(out, indent=2) + "\n")
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 

@@ -241,7 +241,7 @@ def make_http_handler(node: "StorageNodeServer"):
 _TRACED_ROUTES = frozenset({
     "/status", "/files", "/metrics", "/manifest", "/chunking", "/missing",
     "/upload_resume", "/upload", "/download", "/scrub", "/repair",
-    "/trace"})
+    "/trace", "/events", "/doctor"})
 
 
 async def _serve_one(node: "StorageNodeServer",
@@ -296,7 +296,11 @@ async def _serve_one(node: "StorageNodeServer",
     # to it. Streamed-download bodies outlive the span (it covers work
     # up to the response head + first batch) — docs/observability.md.
     name = f"http.{path}" if path in _TRACED_ROUTES else "http.other"
-    with node.obs.request_span(name, parse_http_trace(trace_header)) as sp:
+    # latency=True: per-route histograms (bounded: allowlisted routes +
+    # http.other) whose buckets carry the request's trace id as an
+    # OpenMetrics exemplar — /metrics links a slow bucket to `trace <id>`
+    with node.obs.request_span(name, parse_http_trace(trace_header),
+                               latency=True) as sp:
         out = await _route(node, reader, method, path, query,
                            content_length, range_header, chunked)
         if isinstance(out, (bytes, bytearray)):
@@ -326,8 +330,13 @@ async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
             # latency HISTOGRAM BUCKETS + per-peer/op RPC series
             from dfs_tpu.obs.prom import render_node_metrics
 
+            # OpenMetrics content type, NOT text/plain 0.0.4: the bucket
+            # lines carry exemplar suffixes, which classic-format
+            # parsers reject — the Content-Type tells Prometheus which
+            # parser to use (obs/prom.py module docstring)
             return _resp(200, render_node_metrics(node).encode(),
-                         "text/plain; version=0.0.4; charset=utf-8")
+                         "application/openmetrics-text; version=1.0.0; "
+                         "charset=utf-8")
         snap = node.counters.snapshot()
         snap["nodeId"] = node.cfg.node_id
         snap["underReplicated"] = len(node.under_replicated)
@@ -349,6 +358,34 @@ async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
         # cluster-wide stitch by default; &cluster=0 = this ring only
         return as_json(200, await node.trace_spans(
             tid, cluster=query.get("cluster", "1") != "0"))
+
+    if method == "GET" and path == "/events":
+        # flight-recorder query (docs/observability.md): recent journal
+        # events, oldest first. `since` is a unix-seconds float, `limit`
+        # caps the newest events returned. Journal off -> empty list
+        # with enabled:false, never an error.
+        journal = node.obs.journal
+        if journal is None:
+            return as_json(200, {"enabled": False, "events": []})
+        try:
+            since = float(query.get("since", 0.0))
+            limit = int(query.get("limit", 256))
+        except ValueError:
+            return plain(400, "Bad since/limit")
+        if limit < 1 or limit > 4096:
+            return plain(400, "limit out of range (1..4096)")
+        # segment reads are file I/O — off the event loop like every
+        # other disk touch (dfslint DFS001)
+        out = await asyncio.to_thread(journal.tail, since, limit)
+        out["enabled"] = True
+        return as_json(200, out)
+
+    if method == "GET" and path == "/doctor":
+        # cluster doctor: fan out per-peer snapshots (partial on dead
+        # peers) + run the pathology rule table. &cluster=0 = this node
+        # only (still runs single-node rules).
+        return as_json(200, await node.doctor_report(
+            cluster=query.get("cluster", "1") != "0"))
 
     if method == "GET" and path == "/manifest":
         file_id = query.get("fileId")

@@ -163,6 +163,20 @@ class NodeClient:
         """Prometheus text exposition (GET /metrics?format=prom)."""
         return self._request("GET", "/metrics?format=prom").decode()
 
+    def events(self, since: float = 0.0, limit: int = 256) -> dict:
+        """Flight-recorder tail (GET /events): recent lifecycle events
+        plus journal health counters (dropped/torn)."""
+        q = urllib.parse.urlencode({"since": repr(float(since)),
+                                    "limit": str(int(limit))})
+        return json.loads(self._request("GET", f"/events?{q}"))
+
+    def doctor(self, cluster: bool = True) -> dict:
+        """Cluster doctor report (GET /doctor): per-node snapshots +
+        named pathology findings — render with
+        dfs_tpu.obs.doctor.render_report."""
+        q = urllib.parse.urlencode({"cluster": "1" if cluster else "0"})
+        return json.loads(self._request("GET", f"/doctor?{q}"))
+
     def trace(self, trace_id: str, cluster: bool = True) -> dict:
         """Spans of one trace, stitched cluster-wide by the contacted
         node (GET /trace) — render with dfs_tpu.obs.stitch.render_tree."""

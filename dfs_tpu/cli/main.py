@@ -5,8 +5,8 @@ SURVEY.md §4).
 Interactive menu reproduces Client.java:36-40 exactly:
     0 Exit | 1 Test server | 2 List files | 3 Upload file | 4 Download file
 
-Scriptable subcommands: serve, status, list, upload, download, delete,
-metrics, repair.
+Scriptable subcommands: serve, sidecar, status, list, upload, download,
+delete, metrics, trace, events, doctor, menu.
 """
 
 from __future__ import annotations
@@ -68,7 +68,12 @@ def cmd_serve(args) -> int:
                             slice_inflight=args.replicate_inflight,
                             cas_io_threads=args.cas_io_threads),
         obs=ObsConfig(trace_ring=args.trace_ring,
-                      slow_span_s=args.slow_span))
+                      slow_span_s=args.slow_span,
+                      tail_keep=args.tail_keep,
+                      journal_bytes=args.journal_bytes,
+                      journal_segment_bytes=args.journal_segment_bytes,
+                      sentinel_interval_s=args.sentinel_interval,
+                      sentinel_lag_s=args.sentinel_lag))
 
     async def run() -> None:
         from dfs_tpu.utils.aio import create_logged_task
@@ -230,6 +235,55 @@ def cmd_metrics(args) -> int:
         return 0
     print(json.dumps(_client(args).metrics(), indent=2, sort_keys=True))
     return 0
+
+
+def cmd_events(args) -> int:
+    """Flight-recorder query: recent lifecycle events of one node
+    (GET /events) — one line per event, oldest first."""
+    data = _client(args).events(since=args.since, limit=args.limit)
+    if not data.get("enabled", True):
+        print("(journal disabled on this node)")
+        return 0
+    import datetime
+
+    for ev in data.get("events", []):
+        ts = datetime.datetime.fromtimestamp(
+            ev.get("ts", 0.0)).strftime("%Y-%m-%d %H:%M:%S")
+        etype = ev.get("type", "?")
+        rest = {k: v for k, v in ev.items()
+                if k not in ("ts", "type", "node", "trace")}
+        trace = f" trace={ev['trace']}" if ev.get("trace") else ""
+        extra = " ".join(f"{k}={v}" for k, v in sorted(rest.items()))
+        print(f"{ts} node={ev.get('node', '?')} {etype} {extra}{trace}"
+              .rstrip())
+    if data.get("dropped"):
+        print(f"(warning: {data['dropped']} events dropped at the "
+              "bounded writer)", file=sys.stderr)
+    if data.get("torn"):
+        print(f"({data['torn']} torn/corrupt record(s) skipped)",
+              file=sys.stderr)
+    return 0
+
+
+def cmd_doctor(args) -> int:
+    """Cluster doctor: collect per-node snapshots and print the named
+    pathologies with their evidence (GET /doctor)."""
+    from dfs_tpu.obs.doctor import render_report
+
+    report = _client(args).doctor(cluster=not args.local)
+    print(render_report(report))
+    if args.json:
+        import json
+
+        print(json.dumps(report, indent=2, sort_keys=True))
+    # actionable findings (or unreachable peers) flip the exit code so
+    # the doctor is scriptable as a health gate. info notes (e.g. the
+    # doctor_error a single old-build peer's malformed snapshot earns)
+    # are printed but must not fail a pathology-free cluster.
+    sick = any(f.get("severity") in ("critical", "warning")
+               for f in report.get("findings") or []) \
+        or report.get("peersFailed", 0)
+    return 1 if sick else 0
 
 
 def cmd_trace(args) -> int:
@@ -401,8 +455,26 @@ def build_parser() -> argparse.ArgumentParser:
                        help="finished-span ring capacity (distributed "
                             "tracing); 0 disables tracing entirely")
     serve.add_argument("--slow-span", type=float, default=1.0,
-                       help="slow-span threshold (s) for the trace "
-                            "stitcher's slow-request log")
+                       help="slow threshold (s): trace stitcher slow "
+                            "log AND the tail-retention outlier "
+                            "detector")
+    serve.add_argument("--tail-keep", type=int, default=256,
+                       help="spans of slow/errored traces pinned "
+                            "across ring churn; 0 disables tail "
+                            "retention")
+    serve.add_argument("--journal-bytes", type=int,
+                       default=16 * 1024 * 1024,
+                       help="flight-recorder on-disk budget (JSONL "
+                            "event journal); 0 disables the journal")
+    serve.add_argument("--journal-segment-bytes", type=int,
+                       default=2 * 1024 * 1024,
+                       help="journal segment rotation size")
+    serve.add_argument("--sentinel-interval", type=float, default=1.0,
+                       help="loop-lag/stall sentinel sampling period "
+                            "(s); 0 disables sentinels")
+    serve.add_argument("--sentinel-lag", type=float, default=0.25,
+                       help="event-loop lag (s) above which the "
+                            "sentinel journals a loop_lag incident")
     serve.set_defaults(fn=cmd_serve)
 
     sc = sub.add_parser("sidecar", help="run the chunk+hash sidecar service")
@@ -444,6 +516,23 @@ def build_parser() -> argparse.ArgumentParser:
     mt.add_argument("--prom", action="store_true",
                     help="Prometheus text exposition instead of JSON")
     mt.set_defaults(fn=cmd_metrics)
+    ev = sub.add_parser("events",
+                        help="recent flight-recorder lifecycle events")
+    ev.add_argument("--since", type=float, default=0.0,
+                    help="unix-seconds lower bound (default: all "
+                         "retained)")
+    ev.add_argument("--limit", type=int, default=256,
+                    help="newest events returned (1..4096)")
+    ev.set_defaults(fn=cmd_events)
+    dr = sub.add_parser("doctor",
+                        help="cluster health diagnosis (named "
+                             "pathologies + evidence)")
+    dr.add_argument("--local", action="store_true",
+                    help="diagnose the contacted node only (no peer "
+                         "fan-out)")
+    dr.add_argument("--json", action="store_true",
+                    help="also print the full report as JSON")
+    dr.set_defaults(fn=cmd_doctor)
     tr = sub.add_parser("trace",
                         help="render a stitched cross-node trace")
     tr.add_argument("trace_id")

@@ -232,15 +232,29 @@ class InternalClient:
         for attempt in range(attempts):
             if attempt and self._obs is not None:
                 self._obs.rpc_client.retry(peer.node_id, str(op))
+                # journal the retry (flight recorder): a retry storm on
+                # one peer is the classic early sign of a sick link, and
+                # the per-call metrics only keep totals, not WHEN
+                self._obs.event("rpc_retry", peer=peer.node_id,
+                                op=str(op), attempt=attempt,
+                                cause=type(last).__name__ if last
+                                else None)
             try:
                 return await self._call_once(peer, header, body, timeout_s,
                                              acct)
             except RpcError:
                 raise  # application-level error: retrying won't help
-            except (OSError, asyncio.TimeoutError, RuntimeError) as e:
+            # not silent: the retry is metered (rpc_client.retry) and
+            # journaled (rpc_retry) at the top of the next attempt, and
+            # the terminal failure emits rpc_unreachable + raises
+            except (OSError, asyncio.TimeoutError, RuntimeError) as e:  # dfslint: ignore[DFS007]
                 last = e
                 if attempt + 1 < attempts:
                     await asyncio.sleep(0.05 * (attempt + 1))
+        if self._obs is not None:
+            self._obs.event("rpc_unreachable", peer=peer.node_id,
+                            op=str(op), attempts=attempts,
+                            cause=type(last).__name__)
         raise RpcUnreachable(
             f"peer {peer.node_id} unreachable after {attempts} attempts: "
             f"{type(last).__name__}: {last}")   # TimeoutError strs empty

@@ -254,7 +254,9 @@ class _FrameReceiver(asyncio.BufferedProtocol):
         fv = memoryview(frame).toreadonly()
         try:
             header = _decode_header(fv[:self._hdr_len])
-        except WireError as e:
+        # not silent: _die tears the connection down and propagates the
+        # WireError to every waiter's future
+        except WireError as e:  # dfslint: ignore[DFS007]
             self._die(e)
             return
         self._on_frame(header, fv[self._hdr_len:],

@@ -202,26 +202,52 @@ class ServeConfig:
 
 @dataclasses.dataclass(frozen=True)
 class ObsConfig:
-    """Observability (dfs_tpu.obs): distributed tracing + unified metrics.
+    """Observability (dfs_tpu.obs): distributed tracing, unified metrics,
+    and the diagnosis plane (flight recorder + sentinels + tail-kept
+    outlier traces — docs/observability.md).
 
     Unlike the serve/ingest knobs, tracing defaults ON — the Dapper
     lesson is that always-on cheap tracing is what makes the *one* slow
     request diagnosable after the fact. ``trace_ring=0`` disables span
     collection AND context propagation entirely (the wire/header trace
-    carriers are simply never attached); that is the control arm of the
-    OBS_r09.json overhead measurement. RPC metrics stay on either way.
+    carriers are simply never attached). The diagnosis plane follows the
+    same always-on philosophy: the journal, sentinels and tail retention
+    default on (each individually zeroable), and OBS2_r11.json holds the
+    measured hot-read overhead of everything-on vs everything-off (≤2%
+    gate). RPC metrics stay on either way.
     """
 
     trace_ring: int = 2048      # finished-span ring capacity per node;
                                 # 0 = tracing fully off
-    slow_span_s: float = 1.0    # threshold for the stitcher's
-                                # slow-request log (trace <id> CLI)
+    slow_span_s: float = 1.0    # slow threshold (s): stitcher slow log
+                                # AND the tail-retention outlier detector
+    tail_keep: int = 256        # pinned spans of slow/errored traces
+                                # that survive ring churn; 0 = tail
+                                # retention off (outliers evict normally)
+    journal_bytes: int = 16 * 1024 * 1024   # flight-recorder on-disk
+                                # budget (JSONL segments); 0 = no journal
+    journal_segment_bytes: int = 2 * 1024 * 1024  # journal segment
+                                # rotation size (oldest segments are
+                                # deleted to hold the total budget)
+    sentinel_interval_s: float = 1.0  # loop-lag / stall sampler period;
+                                # 0 = sentinels off
+    sentinel_lag_s: float = 0.25      # event-loop lag above which the
+                                # sentinel journals a loop_lag incident
 
     def __post_init__(self) -> None:
         if self.trace_ring < 0:
             raise ValueError("trace_ring must be >= 0")
         if self.slow_span_s <= 0:
             raise ValueError("slow_span_s must be > 0")
+        if self.tail_keep < 0:
+            raise ValueError("tail_keep must be >= 0")
+        if self.journal_bytes < 0 or self.journal_segment_bytes <= 0:
+            raise ValueError("journal_bytes must be >= 0 and "
+                             "journal_segment_bytes > 0")
+        if self.sentinel_interval_s < 0:
+            raise ValueError("sentinel_interval_s must be >= 0")
+        if self.sentinel_lag_s <= 0:
+            raise ValueError("sentinel_lag_s must be > 0")
 
 
 @dataclasses.dataclass(frozen=True)

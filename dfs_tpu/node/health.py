@@ -23,12 +23,16 @@ from dfs_tpu.utils.logging import get_logger
 class HealthMonitor:
     def __init__(self, cluster: ClusterConfig, self_id: int,
                  client: InternalClient,
-                 probe_interval_s: float = 5.0) -> None:
+                 probe_interval_s: float = 5.0, obs=None) -> None:
         self.cluster = cluster
         self.self_id = self_id
         self.client = client
         self.probe_interval_s = probe_interval_s
         self.log = get_logger("health", self_id)
+        # observability hook: liveness TRANSITIONS are journaled
+        # (peer_down/peer_up flight-recorder events) — the exact
+        # lifecycle facts a post-mortem needs and the process forgets
+        self._obs = obs
         # optimistic start: everyone alive (matches reference behavior of
         # always trying peers); flips on first failure
         self._alive: dict[int, bool] = {
@@ -44,11 +48,17 @@ class HealthMonitor:
         if self._alive.get(node_id):
             self._alive[node_id] = False
             self._last_change[node_id] = time.monotonic()
+            self.log.warning("peer %d marked dead", node_id)
+            if self._obs is not None:
+                self._obs.event("peer_down", peer=node_id)
 
     def mark_alive(self, node_id: int) -> None:
         if not self._alive.get(node_id, True):
             self._alive[node_id] = True
             self._last_change[node_id] = time.monotonic()
+            self.log.info("peer %d back alive", node_id)
+            if self._obs is not None:
+                self._obs.event("peer_up", peer=node_id)
 
     def snapshot(self) -> dict[str, bool]:
         return {str(k): v for k, v in sorted(self._alive.items())}

@@ -204,17 +204,47 @@ class ByteBudget:
             return self._out
 
 
+def _config_fingerprint(cfg: NodeConfig) -> str:
+    """sha256 over the SHARED config surface — everything that should be
+    identical across a healthy cluster. Node-local identity fields
+    (node_id, data_root, sidecar_port) are excluded so the doctor's
+    config_drift rule compares policy, not identity."""
+    import dataclasses as _dc
+    import json as _json
+
+    d = _dc.asdict(cfg)
+    for local in ("node_id", "data_root", "sidecar_port"):
+        d.pop(local, None)
+    return sha256_hex(_json.dumps(d, sort_keys=True,
+                                  default=str).encode())
+
+
 class StorageNodeServer:
     def __init__(self, cfg: NodeConfig) -> None:
         self.cfg = cfg
         self.store = NodeStore(cfg.data_root, cfg.node_id)
         self.counters = Counters()
         self.latency = LatencyRecorder()
+        # flight recorder (obs/journal.py): crash-safe on-disk lifecycle
+        # journal under the node's data root — built before the
+        # Observability hub so every subsystem's obs.event() lands in it
+        journal = None
+        if cfg.obs.journal_bytes > 0:
+            from dfs_tpu.obs.journal import Journal
+
+            journal = Journal(self.store.root / "journal", cfg.node_id,
+                              total_bytes=cfg.obs.journal_bytes,
+                              segment_bytes=cfg.obs.journal_segment_bytes)
         # observability: trace-context propagation + span ring + RPC
         # metric tables (dfs_tpu.obs). Built FIRST — the client, CAS
         # tier, and serving tier all take it as their tracing hook.
         self.obs = Observability(cfg.obs, cfg.node_id,
-                                 latency=self.latency)
+                                 latency=self.latency, journal=journal)
+        # config fingerprint over the SHARED fields (node-local identity
+        # excluded) — the doctor's config_drift rule compares these
+        # across nodes
+        self._config_hash = _config_fingerprint(cfg)
+        self._started_at = time.time()
         # async CAS tier: every event-loop chunk put/get routes through a
         # bounded thread pool (store/aio.py) — the loop never blocks on
         # chunk file I/O and disk concurrency is explicit
@@ -239,10 +269,24 @@ class StorageNodeServer:
                                      coalesce_fetches=cfg.serve.cache_bytes
                                      > 0, obs=self.obs)
         self.health = HealthMonitor(cfg.cluster, cfg.node_id, self.client,
-                                    probe_interval_s=cfg.health_probe_s)
+                                    probe_interval_s=cfg.health_probe_s,
+                                    obs=self.obs)
         # write-path stall attribution (time blocked on credits vs
         # replication vs disk) + pipeline-depth peaks — /metrics "ingest"
         self.ingest_stalls = Stopwatches()
+        # runtime stall sentinel (obs/sentinel.py): loop-lag, CAS-pool
+        # backlog and credit-stall sampling → journal incidents; None
+        # when sampled off. Registered on obs so /metrics "obs" and the
+        # doctor snapshot carry its gauges.
+        self.sentinel = None
+        if cfg.obs.sentinel_interval_s > 0:
+            from dfs_tpu.obs.sentinel import Sentinel
+
+            self.sentinel = Sentinel(self.obs, cas=self.cas,
+                                     stalls=self.ingest_stalls,
+                                     interval_s=cfg.obs.sentinel_interval_s,
+                                     lag_s=cfg.obs.sentinel_lag_s)
+            self.obs.sentinel = self.sentinel
         # read-path serving tier: hot-chunk cache + single-flight +
         # admission gates + readahead. Default config = every component
         # off, and the node runs the historical code paths exactly.
@@ -275,10 +319,19 @@ class StorageNodeServer:
             make_http_handler(self), addr.host, addr.port)
         if self.cfg.health_probe_s > 0:
             self.health.start()
+        if self.sentinel is not None:
+            self.sentinel.start()
+        # flight-recorder boot record: the config this life ran with is
+        # the first question of every post-mortem
+        self.obs.event("boot", configHash=self._config_hash,
+                       http=addr.port, internal=addr.internal_port,
+                       fragmenter=self.fragmenter.name)
         self.log.info("node %d up: http=%d internal=%d",
                       self.cfg.node_id, addr.port, addr.internal_port)
 
     async def stop(self) -> None:
+        if self.sentinel is not None:
+            self.sentinel.stop()
         self.health.stop()
         self.client.close()   # drop pooled peer connections
         self.cas.close()      # async CAS tier workers (non-blocking)
@@ -292,6 +345,12 @@ class StorageNodeServer:
             if srv is not None:
                 srv.close()
                 await srv.wait_closed()
+        if self.obs.journal is not None:
+            # last: every subsystem above may still emit during teardown;
+            # close() drains the bounded queue on the writer thread and
+            # can block seconds on a sick disk (put timeout + join), so
+            # it must not run on the loop — other nodes may share it
+            await asyncio.to_thread(self.obs.journal.close)
 
     # ------------------------------------------------------------------ #
     # internal storage plane (server side)
@@ -342,7 +401,9 @@ class StorageNodeServer:
                         resp, rbody = await self._dispatch(header, body)
                 else:
                     resp, rbody = await self._dispatch(header, body)
-            except Exception as e:  # noqa: BLE001 - report to peer
+            # not silent: the error is returned to the peer in the reply
+            # and recorded on the server span (sp.err)
+            except Exception as e:  # noqa: BLE001  # dfslint: ignore[DFS007]
                 sp.err = type(e).__name__
                 resp, rbody = {"ok": False, "error": str(e)}, b""
             # reply encoded inside the span so sp.bytes carries the real
@@ -359,7 +420,11 @@ class StorageNodeServer:
             conn.send_encoded(head, bufs)
             await conn.drain()
         except (ConnectionError, OSError, WireError):
-            conn.close()   # peer went away mid-reply: nothing to salvage
+            # peer went away mid-reply: nothing to salvage — but count
+            # it (DFS007): a peer that habitually hangs up mid-reply is
+            # a sick link this node would otherwise never surface
+            self.counters.inc("peer_reply_aborted")
+            conn.close()
 
     async def _dispatch(self, header: dict, body) -> tuple[dict, object]:
         op = header.get("op")
@@ -455,6 +520,12 @@ class StorageNodeServer:
             # cheap metadata (bounded ring scan), ungated like health
             return {"ok": True, "spans": self.obs.spans_for(
                 str(header.get("traceId", "")))}, b""
+        if op == "get_doctor":
+            # per-node diagnosis snapshot for the cluster doctor fan-out
+            # (doctor_report below). Ungated like get_trace — diagnosis
+            # must work exactly when the bulk gates are saturated; the
+            # journal/disk reads inside run off-loop.
+            return {"ok": True, "doctor": await self.doctor_snapshot()}, b""
         if op == "health":
             # counts must be O(1)/filename-only: every peer probes this
             # op every few seconds, and the full digests()+manifest-parse
@@ -644,7 +715,9 @@ class StorageNodeServer:
                 m = self.fragmenter.manifest_stream(
                     feed_iter(), name=name or "stream", store=on_chunk)
                 loop.call_soon_threadsafe(outq.put_nowait, ("done", m))
-            except BaseException as e:  # surfaced to the async side
+            # not silent: surfaced to the async consumer via the
+            # ("error", e) queue item, which re-raises on the loop
+            except BaseException as e:  # dfslint: ignore[DFS007]
                 loop.call_soon_threadsafe(outq.put_nowait, ("error", e))
             finally:
                 frag_dead.set()
@@ -834,7 +907,11 @@ class StorageNodeServer:
                     {"op": "has_chunks", "digests": ds}, retries=1)
                 found.update(resp.get("have", []))
             except RpcError:
-                pass
+                # best-effort: an unanswered probe only makes the client
+                # resend bytes the cluster already has — but count it
+                # (DFS007): habitual probe failures silently erase the
+                # resume/dedup win
+                self.counters.inc("probe_failures")
 
         await asyncio.gather(*(probe(n, ds) for n, ds in by_peer.items()))
         return [d for d in missing if d not in found]
@@ -1184,6 +1261,11 @@ class StorageNodeServer:
         # Write-quorum policy (vs reference write-all abort, :218-221).
         failed = [d for d, n in copies.items() if n < quorum]
         if failed:
+            # journaled: a quorum failure is the write path's loudest
+            # lifecycle event and the HTTP 500 it becomes carries no
+            # cluster state — the flight recorder keeps the evidence
+            self.obs.event("quorum_fail", chunksBelow=len(failed),
+                           quorum=quorum)
             raise UploadError(
                 f"Replication failed: {len(failed)} chunks below quorum "
                 f"{quorum}")
@@ -1253,7 +1335,11 @@ class StorageNodeServer:
                 self.health.mark_dead(target)
                 continue
             except RpcError:
-                continue  # live peer without the chunk — not a death signal
+                # live peer without the chunk — not a death signal, but
+                # counted (DFS007): a ring walk that keeps missing is
+                # placement skew the terminal DownloadError hides
+                self.counters.inc("remote_chunk_misses")
+                continue
             # Verify against the manifest digest before trusting a peer
             # (stronger than the reference, which only checks the whole file).
             if len(data) == length and sha256_hex(data) == digest:
@@ -1355,9 +1441,16 @@ class StorageNodeServer:
                 except RpcUnreachable:
                     self.health.mark_dead(node_id)
                     got = []
-                except (RpcError, WireError):
+                except (RpcError, WireError) as e:
                     # WireError: peer sent a malformed chunk table — as
-                    # recoverable as corrupt bytes; other replicas serve
+                    # recoverable as corrupt bytes; other replicas serve.
+                    # Counted (DFS007): a byzantine peer that keeps
+                    # sending garbage must not stay invisible just
+                    # because its replicas covered for it.
+                    self.counters.inc("fetch_batch_errors")
+                    self.log.warning("batched fetch from node %d failed:"
+                                     " %s: %s", node_id,
+                                     type(e).__name__, e)
                     got = []
                 if got:
                     hexes = sha256_many_hex([b for _, b in got])
@@ -1435,7 +1528,10 @@ class StorageNodeServer:
                     for d in resp.get("have", []):
                         claims.setdefault(d, nid)
                 except RpcError:
-                    pass
+                    # best-effort sweep; counted (DFS007) — habitual
+                    # probe failures silently shrink the replica set a
+                    # degraded read can draw from
+                    self.counters.inc("probe_failures")
 
             others = [p.node_id for p in self._peers()]
             await asyncio.gather(*(who_has(n) for n in others))
@@ -1462,8 +1558,10 @@ class StorageNodeServer:
                 async with sem:
                     try:
                         out[d] = await self._fetch_chunk(d, need[d])
-                    except DownloadError:
-                        pass    # strict raise handled below
+                    # not silent: the digest stays missing and the strict
+                    # raise / best-effort skip below carries the failure
+                    except DownloadError:  # dfslint: ignore[DFS007]
+                        pass
 
             await asyncio.gather(*(one(d) for d in missing))
             missing = [d for d in need if d not in out]
@@ -1645,7 +1743,9 @@ class StorageNodeServer:
             for peer in self._peers():
                 try:
                     mj, mt = await self.client.get_manifest(peer, file_id)
-                except RpcError:
+                # not silent: the next peer is tried, and a total miss
+                # raises DownloadError("Unknown fileId") right below
+                except RpcError:  # dfslint: ignore[DFS007]
                     continue
                 if mj:
                     manifest = Manifest.from_json(mj)
@@ -1771,7 +1871,9 @@ class StorageNodeServer:
                 for d, fut in waits.items():
                     try:
                         out[d] = await serve.flight.wait(fut)
-                    except DownloadError:
+                    # not silent: the digest joins failed_waits and is
+                    # re-fetched directly right below
+                    except DownloadError:  # dfslint: ignore[DFS007]
                         failed_waits.append(d)
                     except asyncio.CancelledError:
                         if not fut.done():
@@ -1819,6 +1921,8 @@ class StorageNodeServer:
                 self.under_replicated.add(d)
                 self.log.warning("evicted corrupt local chunk %s on read",
                                  d[:12])
+                self.obs.event("corrupt_chunk", digest=d[:12],
+                               where="read")
         return await self._gather_chunks(manifest, chunks=chunks,
                                          prefetched=good, strict=strict)
 
@@ -1962,7 +2066,8 @@ class StorageNodeServer:
                     retries=1)
                 spans = resp.get("spans")
                 return spans if isinstance(spans, list) else []
-            except RpcError:
+            # not silent: None is counted into the report's peersFailed
+            except RpcError:  # dfslint: ignore[DFS007]
                 return None
 
         for got in await asyncio.gather(*(one(p) for p in peers)):
@@ -1974,6 +2079,97 @@ class StorageNodeServer:
                 "slowSpanS": self.cfg.obs.slow_span_s,
                 "spans": merge_spans(lists),
                 "peersQueried": len(peers), "peersFailed": failed}
+
+    # ------------------------------------------------------------------ #
+    # cluster doctor (docs/observability.md)
+    # ------------------------------------------------------------------ #
+
+    async def doctor_snapshot(self) -> dict:
+        """This node's diagnosis snapshot: the per-node material the
+        doctor rule table consumes — metric summaries, recent journal
+        incidents, disk headroom, config fingerprint, wall clock. Every
+        blocking read (journal tail, disk_usage, chunk count priming)
+        runs off the event loop."""
+        import shutil
+
+        def disk() -> dict:
+            try:
+                u = shutil.disk_usage(self.store.root)
+                return {"totalBytes": u.total, "freeBytes": u.free}
+            # not silent: {} renders as unknown headroom in the report
+            except OSError:  # dfslint: ignore[DFS007]
+                return {}
+
+        incidents: list[dict] = []
+        if self.obs.journal is not None:
+            tail = await asyncio.to_thread(self.obs.journal.tail, 0.0, 64)
+            incidents = tail.get("events", [])
+        obs_stats = self.obs.stats()
+        return {
+            "nodeId": self.cfg.node_id,
+            "now": time.time(),
+            "uptimeS": round(time.time() - self._started_at, 3),
+            "configHash": self._config_hash,
+            "chunks": await asyncio.to_thread(self.store.chunks.count),
+            "files": len(self.store.manifests.ids()),
+            "peersAlive": self.health.snapshot(),
+            "underReplicated": len(self.under_replicated),
+            "admission": self.serve.admission.stats(),
+            "cache": self.serve.cache.stats()
+            if self.serve.cache is not None else {"enabled": False},
+            "ingestStalls": self.ingest_stalls.snapshot(),
+            "cas": self.cas.stats(),
+            "sentinel": obs_stats["sentinel"],
+            "journal": obs_stats["journal"],
+            "rpcClient": obs_stats["rpcClient"],
+            "counters": self.counters.snapshot(),
+            "incidents": incidents,
+            "disk": await asyncio.to_thread(disk),
+        }
+
+    async def doctor_report(self, cluster: bool = True) -> dict:
+        """The cluster doctor: fan out ``get_doctor`` to every peer
+        (bounded — one fast attempt per peer, partial on dead peers,
+        exactly like ``/trace``), then run the pathology rule table
+        (obs/doctor.py) over the snapshots. A peer that cannot answer IS
+        a finding (dead_peer), never an error — the doctor must work
+        exactly when something is wrong."""
+        from dfs_tpu.obs.doctor import diagnose
+
+        snaps: dict[int, dict | None] = {
+            self.cfg.node_id: await self.doctor_snapshot()}
+        # clock_skew compares each snapshot's capture-time "now" against
+        # the moment THIS coordinator received it — never against a
+        # single post-fan-out timestamp, which one hung peer would drag
+        # seconds past every fast answer and misdiagnose the whole live
+        # cluster as skewed.
+        snaps[self.cfg.node_id]["receivedAt"] = time.time()
+        failed = 0
+        peers = self._peers() if cluster else []
+
+        async def one(peer) -> tuple[int, dict | None]:
+            try:
+                resp, _ = await self.client.call(
+                    peer, {"op": "get_doctor"}, retries=1)
+                d = resp.get("doctor")
+                if isinstance(d, dict):
+                    d["receivedAt"] = time.time()
+                    return peer.node_id, d
+                return peer.node_id, None
+            # not silent: a None snapshot IS the dead_peer finding
+            except RpcError:  # dfslint: ignore[DFS007]
+                return peer.node_id, None
+
+        for nid, snap in await asyncio.gather(*(one(p) for p in peers)):
+            snaps[nid] = snap
+            if snap is None:
+                failed += 1
+        now = time.time()
+        findings = diagnose(snaps, coordinator_now=now)
+        return {"coordinator": self.cfg.node_id, "now": now,
+                "peersFailed": failed,
+                "nodes": {str(k): v for k, v in sorted(snaps.items())},
+                "findings": findings}
 
     def list_files(self) -> list[dict]:
         return [{"fileId": m.file_id, "name": m.name, "size": m.size,
@@ -2015,7 +2211,11 @@ class StorageNodeServer:
             try:
                 await self.client.call(peer, {"op": "delete", "fileId": file_id})
             except RpcError:
-                pass
+                # journaled (DFS007): the delete converges later via
+                # tombstone anti-entropy, but "peer N kept serving a
+                # deleted file for an hour" starts exactly here
+                self.obs.event("delete_propagate_fail", peer=peer.node_id,
+                               fileId=file_id[:12])
 
         # Best-effort immediate propagation; a node that is down right now
         # converges later via tombstone anti-entropy in repair_once.
@@ -2042,6 +2242,9 @@ class StorageNodeServer:
                     peer, {"op": "tombstones"}, retries=1)
                 self.health.mark_alive(peer.node_id)
             except RpcError:
+                # counted (DFS007): anti-entropy that silently fails
+                # every cycle IS the cluster not converging
+                self.counters.inc("antientropy_rpc_failures")
                 continue
             for t in resp.get("tombs", []):
                 fid, ts = t.get("id"), t.get("ts")
@@ -2073,7 +2276,7 @@ class StorageNodeServer:
                             await self.client.announce(peer, m.to_json(),
                                                        fresh=True)
                         except RpcError:
-                            pass
+                            self.counters.inc("antientropy_rpc_failures")
                     continue
                 # propagate with the ORIGIN timestamp (re-stamping would
                 # let the tombstone's ts creep forward as it gossips);
@@ -2102,6 +2305,7 @@ class StorageNodeServer:
                     peer, {"op": "list_manifests"}, retries=1)
                 self.health.mark_alive(peer.node_id)
             except RpcError:
+                self.counters.inc("antientropy_rpc_failures")
                 continue
             for fid in resp.get("ids", []):
                 if (fid in known or not is_hex_digest(fid)
@@ -2110,6 +2314,7 @@ class StorageNodeServer:
                 try:
                     mj, mt = await self.client.get_manifest(peer, fid)
                 except RpcError:
+                    self.counters.inc("antientropy_rpc_failures")
                     continue
                 if mj:
                     try:
@@ -2238,7 +2443,10 @@ class StorageNodeServer:
                             continue
                         try:
                             b = await self._fetch_chunk(d, chunk_len[d])
-                        except DownloadError:
+                        # not silent: the chunk stays in
+                        # under_replicated (surfaced in /metrics and the
+                        # doctor snapshot) and next cycle retries
+                        except DownloadError:  # dfslint: ignore[DFS007]
                             continue
                     payload.append((d, b))
                 if payload:
@@ -2256,7 +2464,13 @@ class StorageNodeServer:
                         ok = {d for d, _ in part} & echoed
                         repaired += len(ok)
                         verified |= ok
-            except RpcError:
+            except RpcError as e:
+                # journaled (DFS007): the chunks stay in
+                # under_replicated and next cycle retries, but a repair
+                # push that fails every hour is a durability hole with a
+                # date on it
+                self.obs.event("repair_push_fail", peer=peer.node_id,
+                               cause=type(e).__name__)
                 continue
         # only drop repair entries we actually confirmed on a peer
         self.under_replicated -= verified
@@ -2268,6 +2482,12 @@ class StorageNodeServer:
         if swept:
             self.serve.drop_cached(swept)
             self.log.info("gc: swept %d aged orphan chunks", len(swept))
+        if repaired or swept:
+            # repair/GC decisions are exactly the state changes a
+            # post-mortem needs dated — journal them (flight recorder)
+            self.obs.event("repair", repaired=repaired,
+                           sweptOrphans=len(swept),
+                           underReplicated=len(self.under_replicated))
         self.counters.inc("repairs")
         return repaired
 
@@ -2307,4 +2527,6 @@ class StorageNodeServer:
         self.counters.inc("scrubs")
         if corrupt:
             self.counters.inc("scrub_corrupt", corrupt)
+            self.obs.event("scrub_corrupt", scanned=scanned,
+                           corrupt=corrupt)
         return {"scanned": scanned, "corrupt": corrupt}

@@ -131,45 +131,92 @@ class RpcStats:
     ``/metrics`` unboundedly (same discipline as Counters)."""
 
     _MAX_KEYS = 256
+    # recency window behind snapshot()'s recentSeconds/recentCount: the
+    # doctor's slow_peer rule reads WINDOWED means, so a peer that spent
+    # an hour dead (accumulating ~75ms connect-timeout "calls" in the
+    # lifetime table) is not diagnosed slow forever after it recovers —
+    # the same no-latching rationale as shed_storm/loop_lag. The per-key
+    # sample is bounded: at extreme call rates the window simply covers
+    # the most recent _RECENT_MAX calls.
+    RECENT_WINDOW_S = 60.0
+    _RECENT_MAX = 512
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         # (peer, op) -> [count, errors, retries, bytes_out, bytes_in, s]
         self._m: dict[tuple, list] = {}
+        # (peer, op) -> [deque[(monotonic ts, seconds)], rolling sum,
+        # rolling count] for the window — sums maintained on append and
+        # expiry so snapshot() never scans a deque under the lock the
+        # data plane's record() takes
+        self._recent: dict[tuple, list] = {}
         self._overflow_warned = False
 
-    def _row(self, peer, op) -> list:
+    def _row(self, peer, op) -> tuple[tuple, list]:
         key = capped_key(self._m, (peer, op), self._MAX_KEYS, self,
                          "RpcStats", ("_overflow", "_overflow"))
         row = self._m.get(key)
         if row is None:
             row = self._m[key] = [0, 0, 0, 0, 0, 0.0]
-        return row
+        return key, row
 
     def record(self, peer, op: str, seconds: float, bytes_out: int = 0,
                bytes_in: int = 0, error: bool = False) -> None:
+        now = time.monotonic()
         with self._lock:
-            row = self._row(peer, op)
+            key, row = self._row(peer, op)
             row[0] += 1
             if error:
                 row[1] += 1
             row[3] += bytes_out
             row[4] += bytes_in
             row[5] += seconds
+            ent = self._recent.get(key)
+            if ent is None:
+                ent = self._recent[key] = [deque(), 0.0, 0]
+            ent[0].append((now, seconds))
+            ent[1] += seconds
+            ent[2] += 1
+            self._expire(ent, now)
+
+    def _expire(self, ent: list, now: float) -> None:
+        """Drop window-expired (and over-bound) samples, keeping the
+        rolling sums exact. Lock held by the caller."""
+        dq = ent[0]
+        cutoff = now - self.RECENT_WINDOW_S
+        while dq and (dq[0][0] < cutoff or len(dq) > self._RECENT_MAX):
+            _, s = dq.popleft()
+            ent[1] -= s
+            ent[2] -= 1
+        if ent[2] == 0:
+            ent[1] = 0.0   # re-zero float drift at every empty window
 
     def retry(self, peer, op: str) -> None:
         with self._lock:
-            self._row(peer, op)[2] += 1
+            _, row = self._row(peer, op)
+            row[2] += 1
 
     def snapshot(self) -> dict:
-        """JSON /metrics shape: '<peer>:<op>' -> counters dict."""
+        """JSON /metrics shape: '<peer>:<op>' -> counters dict.
+        ``recentSeconds``/``recentCount`` cover RECENT_WINDOW_S."""
+        now = time.monotonic()
         with self._lock:
-            return {f"{p}:{o}": {"count": r[0], "errors": r[1],
-                                 "retries": r[2], "bytesOut": r[3],
-                                 "bytesIn": r[4],
-                                 "seconds": round(r[5], 6)}
-                    for (p, o), r in sorted(self._m.items(),
-                                            key=lambda kv: str(kv[0]))}
+            out = {}
+            for (p, o), r in sorted(self._m.items(),
+                                    key=lambda kv: str(kv[0])):
+                ent = self._recent.get((p, o))
+                if ent is not None:
+                    self._expire(ent, now)
+                    rs, rc = ent[1], ent[2]
+                else:
+                    rs, rc = 0.0, 0
+                out[f"{p}:{o}"] = {"count": r[0], "errors": r[1],
+                                   "retries": r[2], "bytesOut": r[3],
+                                   "bytesIn": r[4],
+                                   "seconds": round(r[5], 6),
+                                   "recentSeconds": round(rs, 6),
+                                   "recentCount": rc}
+            return out
 
     def rows(self) -> list[tuple[str, str, list]]:
         """(peer, op, [count, errors, retries, bytes_out, bytes_in, s])
@@ -195,18 +242,38 @@ def _span_dict(r: tuple) -> dict:
 
 class Observability:
     """One node's observability state: span ring + RPC metric tables +
-    the shared :class:`LatencyRecorder`. Constructed unconditionally by
-    the node runtime; ``ObsConfig(trace_ring=0)`` turns every tracing
-    path into a constant-time no-op while the metric tables stay live.
+    the shared :class:`LatencyRecorder`, plus (since r11) the diagnosis
+    hooks — the flight-recorder journal, the tail-retention store that
+    pins slow/errored traces across ring churn, and the sentinel gauge
+    surface. Constructed unconditionally by the node runtime;
+    ``ObsConfig(trace_ring=0)`` turns every tracing path into a
+    constant-time no-op while the metric tables stay live.
     """
 
+    # traces the tail store tracks at once; oldest forgotten first (its
+    # already-pinned spans stay until the span-count bound evicts them)
+    _MAX_INTERESTING = 128
+
     def __init__(self, cfg, node_id: int,
-                 latency: LatencyRecorder | None = None) -> None:
+                 latency: LatencyRecorder | None = None,
+                 journal=None) -> None:
         self.cfg = cfg
         self.node_id = node_id
         self.latency = latency if latency is not None else LatencyRecorder()
         self._ring: deque | None = deque(maxlen=cfg.trace_ring) \
             if cfg.trace_ring > 0 else None
+        # tail retention (Dapper's tail-sampling lesson): spans of
+        # slow/errored traces are COPIED here and survive main-ring
+        # eviction — bounded by span count, FIFO. None = feature off.
+        self._tail: deque | None = deque() \
+            if cfg.tail_keep > 0 and self._ring is not None else None
+        self._tail_ids: set[str] = set()
+        self._interesting: dict[str, None] = {}   # insertion-ordered
+        # flight recorder (obs/journal.py) — None when journaling is off
+        # or the owner (tests, standalone tools) never attached one
+        self.journal = journal
+        # set by the node runtime when sentinels run; stats() surfaces it
+        self.sentinel = None
         self._lock = threading.Lock()
         self.rpc_client = RpcStats()
         self.rpc_server = RpcStats()
@@ -214,6 +281,18 @@ class Observability:
     @property
     def enabled(self) -> bool:
         return self._ring is not None
+
+    # ---- lifecycle events (flight recorder) --------------------------- #
+
+    def event(self, etype: str, **fields) -> None:
+        """Record one lifecycle event in the journal, stamped with the
+        active trace id. No-op without a journal; never blocks (the
+        journal writer is a bounded-queue thread)."""
+        j = self.journal
+        if j is None:
+            return
+        cur = _ctx.get() if self._ring is not None else None
+        j.emit(etype, fields, trace=cur[0] if cur is not None else None)
 
     # ---- propagation carriers ---------------------------------------- #
 
@@ -259,16 +338,51 @@ class Observability:
             _ctx.reset(tok)
             dur = time.perf_counter() - t0
             if latency_name is not None:
-                self.latency.record(latency_name, dur)
+                # traced observations carry their trace id as the
+                # bucket's OpenMetrics exemplar (/metrics?format=prom)
+                self.latency.record(latency_name, dur, exemplar=tid)
             ring = self._ring
             if ring is not None:
+                rec = (tid, sid, parent, name, self.node_id,
+                       t_wall, dur, peer, sp.bytes, err or sp.err)
                 with self._lock:
-                    ring.append((tid, sid, parent, name, self.node_id,
-                                 t_wall, dur, peer, sp.bytes,
-                                 err or sp.err))
+                    ring.append(rec)
+                    if self._tail is not None:
+                        self._tail_note(rec)
             if ann is not None:
                 with contextlib.suppress(Exception):
                     ann.__exit__(None, None, None)
+
+    # ---- tail retention (lock held by caller) ------------------------- #
+
+    def _tail_note(self, rec: tuple) -> None:
+        """Pin spans of outlier traces. A span that is slow (>=
+        slow_span_s) or errored marks its whole trace interesting: the
+        trace's spans already in the main ring are copied into the tail
+        store, and every later span of the trace lands there too — so
+        the one request worth diagnosing survives the churn of the
+        thousand ordinary ones that follow it (Dapper's tail lesson)."""
+        tid = rec[0]
+        if tid not in self._interesting:
+            if not (rec[9] or rec[6] >= self.cfg.slow_span_s):
+                return
+            while len(self._interesting) >= self._MAX_INTERESTING:
+                del self._interesting[next(iter(self._interesting))]
+            self._interesting[tid] = None
+            # sweep earlier spans of this trace out of the mortal ring
+            for r in self._ring:
+                if r[0] == tid and r[1] != rec[1]:
+                    self._tail_pin(r)
+        self._tail_pin(rec)
+
+    def _tail_pin(self, rec: tuple) -> None:
+        if rec[1] in self._tail_ids:
+            return
+        while len(self._tail) >= self.cfg.tail_keep:
+            old = self._tail.popleft()
+            self._tail_ids.discard(old[1])
+        self._tail.append(rec)
+        self._tail_ids.add(rec[1])
 
     @contextlib.contextmanager
     def span(self, name: str, peer=None, latency: bool = False):
@@ -297,20 +411,33 @@ class Observability:
 
     @contextlib.contextmanager
     def request_span(self, name: str,
-                     incoming: tuple[str, str] | None = None, peer=None):
+                     incoming: tuple[str, str] | None = None, peer=None,
+                     latency: bool = False):
         """Entry-point span (HTTP layer): adopts (trace_id, parent) from
         an inbound ``X-Dfs-Trace`` carrier, or roots a fresh trace —
         always-on tracing means every request is traceable, not only the
-        ones a client asked about."""
+        ones a client asked about. ``latency=True`` records the span's
+        duration under ``name`` — traced requests tag the bucket they
+        land in with their trace id (the OpenMetrics exemplar the
+        ``/metrics?format=prom`` exposition serves), and the name stays
+        a bounded-cardinality histogram key even with tracing off (the
+        HTTP layer only passes allowlisted route names)."""
         if self._ring is None:
-            yield _NULL_SPAN
+            if not latency:
+                yield _NULL_SPAN
+                return
+            t0 = time.perf_counter()
+            try:
+                yield _NULL_SPAN
+            finally:
+                self.latency.record(name, time.perf_counter() - t0)
             return
         if incoming is not None:
             tid, parent = incoming
         else:
             tid, parent = new_trace_id(), None
         yield from self._traced(name, tid, new_span_id(), parent, peer,
-                                None)
+                                name if latency else None)
 
     @contextlib.contextmanager
     def server_span(self, name: str,
@@ -334,20 +461,36 @@ class Observability:
     # ---- query ------------------------------------------------------- #
 
     def spans_for(self, trace_id: str) -> list[dict]:
-        """Finished spans of one trace still in the ring (oldest first)."""
+        """Finished spans of one trace still resident — main ring plus
+        the tail-retention store (outlier traces outlive ring churn
+        there), deduped by span id, ordered by wall start."""
         if self._ring is None:
             return []
         with self._lock:
             rows = [r for r in self._ring if r[0] == trace_id]
+            if self._tail is not None:
+                have = {r[1] for r in rows}
+                rows.extend(r for r in self._tail
+                            if r[0] == trace_id and r[1] not in have)
+        rows.sort(key=lambda r: r[5])
         return [_span_dict(r) for r in rows]
 
     def stats(self) -> dict:
         """JSON ``/metrics`` ``obs`` section. The ``traceRing`` /
-        ``slowSpanS`` keys mirror the ObsConfig fields (dfslint DFS005
-        checks this mapping)."""
+        ``slowSpanS`` / ``tailKeep`` keys mirror ObsConfig fields;
+        ``journal`` / ``sentinel`` carry the flight-recorder and sampler
+        sub-sections (dfslint DFS005 checks the field⇄key mapping)."""
+        with self._lock:
+            tail_spans = len(self._tail) if self._tail is not None else 0
         return {"traceRing": self.cfg.trace_ring,
                 "slowSpanS": self.cfg.slow_span_s,
+                "tailKeep": self.cfg.tail_keep,
                 "spans": len(self._ring) if self._ring is not None else 0,
+                "tailSpans": tail_spans,
+                "journal": self.journal.stats()
+                if self.journal is not None else {"enabled": False},
+                "sentinel": self.sentinel.stats()
+                if self.sentinel is not None else {"enabled": False},
                 "rpcClient": self.rpc_client.snapshot(),
                 "rpcServer": self.rpc_server.snapshot()}
 

@@ -1,0 +1,324 @@
+"""Cluster doctor: a rule table that names known pathologies.
+
+Diagnosing a sick cluster by hand means correlating ``/metrics``,
+``/events`` and ``/trace`` across N nodes. The doctor automates the
+first pass: ``GET /doctor?cluster=1`` on any node fans out (bounded,
+partial-on-dead-peers — exactly like ``/trace``) collecting each peer's
+snapshot (metric summary, recent incidents, disk headroom, config
+hash, wall clock), then :func:`diagnose` walks the rule table below and
+names what it sees WITH the evidence — a starting hypothesis, not a
+verdict.
+
+Rules (each produces ``{"rule", "severity", "peers", "evidence"}``):
+
+- ``dead_peer``      — a peer did not answer the doctor probe, or any
+                       node's health registry reports it dead.
+- ``slow_peer``      — a peer's observed RPC latency (mean seconds/call
+                       aggregated across every reporting node's client
+                       table, WINDOWED via recentSeconds/recentCount so
+                       a recovered peer's dead-period timeouts age out)
+                       exceeds 3x the median of the other peers and an
+                       absolute floor (50 ms) — relative, so a
+                       uniformly-loaded cluster is not all "slow".
+- ``shed_storm``     — admission gates shed requests (503s served)
+                       RECENTLY (the gate's ~60 s ``shedRecent``
+                       window, not the since-boot counter — a transient
+                       overload must not latch the diagnosis red
+                       forever; old-build peers without the windowed
+                       gauge fall back to the lifetime total).
+- ``credit_starvation`` — ingest chunking spent significant time
+                       blocked on byte credits (placement is the
+                       bottleneck) on some node.
+- ``cache_thrash``   — a serve cache with enough traffic to judge is
+                       evicting heavily at a low hit rate (budget too
+                       small for the working set).
+- ``clock_skew``     — a peer's reported wall clock differs by more
+                       than 2 s from the coordinator's clock at the
+                       moment that peer's answer arrived (LWW tombstone
+                       ordering and journal timestamps both lean on
+                       wall clocks).
+- ``config_drift``   — config fingerprints (sha256 over the shared
+                       NodeConfig fields — node-local identity fields
+                       excluded) differ across nodes: a rolling restart
+                       left the cluster half-configured.
+- ``loop_lag``       — a node's sentinel observed event-loop stalls at
+                       or beyond its threshold within its recency
+                       window (``recentMaxLagS``, ~60 s — same
+                       no-latching rationale as ``shed_storm``;
+                       lifetime ``maxLagS`` is the old-build fallback).
+                       Something occupied the loop; see its journal
+                       for when.
+
+Thresholds live here as module constants, documented in
+docs/observability.md; the bench's injected-slow-peer scenario
+(OBS2_r11.json) pins that ``slow_peer`` actually names the right node.
+"""
+
+from __future__ import annotations
+
+SLOW_PEER_FACTOR = 3.0     # x median of the other peers
+SLOW_PEER_FLOOR_S = 0.050  # absolute mean-latency floor
+CLOCK_SKEW_S = 2.0
+CACHE_MIN_LOOKUPS = 1024   # judge thrash only with real traffic
+CACHE_HIT_FLOOR = 0.5
+CREDIT_STALL_MIN_S = 1.0
+
+
+def _median(xs: list[float]) -> float:
+    xs = sorted(xs)
+    n = len(xs)
+    if n == 0:
+        return 0.0
+    mid = n // 2
+    return xs[mid] if n % 2 else (xs[mid - 1] + xs[mid]) / 2.0
+
+
+def _rpc_means(snapshots: dict) -> dict[int, tuple[float, int]]:
+    """peer id -> (mean seconds per call, calls) aggregated over every
+    reporting node's client RPC table — every node's view of how slow
+    each peer answers, combined. WINDOWED when available
+    (``recentSeconds``/``recentCount``, RpcStats.RECENT_WINDOW_S): a
+    peer that spent an hour dead accumulates connect-timeout seconds in
+    the lifetime table and would read "slow" forever after recovering —
+    the same no-latching rationale as shed_storm/loop_lag. Lifetime
+    totals are the old-build fallback."""
+    seconds: dict[int, float] = {}
+    calls: dict[int, int] = {}
+    for snap in snapshots.values():
+        if not snap:
+            continue
+        for key, row in (snap.get("rpcClient") or {}).items():
+            if not isinstance(key, str) or not isinstance(row, dict):
+                continue   # malformed wire row: skip, don't lose the rule
+            peer, _, _op = key.partition(":")
+            try:
+                pid = int(peer)
+            except ValueError:
+                continue   # _overflow fold or non-numeric label
+            if "recentCount" in row:
+                seconds[pid] = seconds.get(pid, 0.0) \
+                    + row.get("recentSeconds", 0.0)
+                calls[pid] = calls.get(pid, 0) + row.get("recentCount", 0)
+            else:
+                seconds[pid] = seconds.get(pid, 0.0) \
+                    + row.get("seconds", 0.0)
+                calls[pid] = calls.get(pid, 0) + row.get("count", 0)
+    return {pid: (seconds[pid] / calls[pid], calls[pid])
+            for pid in seconds if calls.get(pid)}
+
+
+def diagnose(snapshots: dict[int, dict | None],
+             coordinator_now: float) -> list[dict]:
+    """Run the rule table over per-node snapshots (None = the peer did
+    not answer). Returns findings, most severe first; an empty list is
+    a healthy report, not a failure to look.
+
+    Every rule runs FAULT-ISOLATED: snapshot fields come over the wire
+    from peers that may run a different build (or be the very thing
+    that is broken), so a malformed field must cost at most the rule it
+    confuses — never the report. A crashed rule keeps whatever findings
+    it appended and adds a visible ``doctor_error`` note naming the
+    rule; it is never swallowed silently."""
+    findings: list[dict] = []
+    live = {nid: s for nid, s in snapshots.items()
+            if isinstance(s, dict)}
+
+    def dead_peer() -> None:
+        # no snapshot, or any live node's health registry says so
+        dead = sorted(nid for nid, s in snapshots.items()
+                      if not isinstance(s, dict))
+        reported_dead: dict[int, list[int]] = {}
+        for nid, snap in live.items():
+            for peer, alive in (snap.get("peersAlive") or {}).items():
+                if alive:
+                    continue
+                try:
+                    reported_dead.setdefault(int(peer), []).append(nid)
+                except (TypeError, ValueError):
+                    continue   # malformed registry key: skip, keep the
+                    # rule — dead_peer is the one finding the doctor
+                    # must never lose
+        for nid in sorted(set(dead) | set(reported_dead)):
+            ev = []
+            if nid in dead:
+                ev.append("no answer to the doctor probe")
+            if nid in reported_dead:
+                ev.append("reported dead by node(s) "
+                          f"{sorted(reported_dead[nid])}")
+            findings.append({"rule": "dead_peer", "severity": "critical",
+                             "peers": [nid], "evidence": "; ".join(ev)})
+
+    def slow_peer() -> None:
+        # relative to the median of the OTHER peers
+        means = _rpc_means(live)
+        for pid in sorted(means):
+            mean, n = means[pid]
+            others = [m for q, (m, _) in means.items() if q != pid]
+            if not others:
+                continue
+            base = _median(others)
+            if mean >= SLOW_PEER_FLOOR_S \
+                    and mean > SLOW_PEER_FACTOR * base:
+                findings.append({
+                    "rule": "slow_peer", "severity": "warning",
+                    "peers": [pid],
+                    "evidence": f"mean RPC {mean * 1000:.1f}ms over {n} "
+                                f"calls vs {base * 1000:.1f}ms median of "
+                                "the other peers"})
+
+    def shed_storm() -> None:
+        # windowed: "shed" is a since-boot counter, so diagnosing on it
+        # would latch this finding red forever after one transient
+        # overload (the doctor CLI gates health scripts on exit code).
+        # "shedRecent" covers the gate's last ~60s; an old-build peer
+        # without the windowed gauge falls back to the lifetime total —
+        # latching beats losing the rule cross-version.
+        shedders = []
+        total_shed = 0
+        for nid, snap in sorted(live.items()):
+            shed = sum(g.get("shedRecent", g.get("shed", 0))
+                       for g in (snap.get("admission") or {}).values()
+                       if isinstance(g, dict))
+            if shed:
+                shedders.append(nid)
+                total_shed += shed
+        if shedders:
+            findings.append({"rule": "shed_storm", "severity": "warning",
+                             "peers": shedders,
+                             "evidence": f"{total_shed} requests shed "
+                                         f"(503) recently by node(s) "
+                                         f"{shedders}"})
+
+    def credit_starvation() -> None:
+        for nid, snap in sorted(live.items()):
+            credit = (snap.get("ingestStalls") or {}).get("creditS", 0.0)
+            if credit >= CREDIT_STALL_MIN_S:
+                findings.append({
+                    "rule": "credit_starvation", "severity": "warning",
+                    "peers": [nid],
+                    "evidence": f"chunking blocked {credit:.1f}s on byte "
+                                "credits (placement is the bottleneck)"})
+
+    def cache_thrash() -> None:
+        for nid, snap in sorted(live.items()):
+            c = snap.get("cache") or {}
+            if not c.get("enabled"):
+                continue
+            lookups = c.get("hits", 0) + c.get("misses", 0)
+            if lookups < CACHE_MIN_LOOKUPS:
+                continue
+            rate = c.get("hits", 0) / lookups
+            if rate < CACHE_HIT_FLOOR and c.get("evictions", 0) \
+                    > c.get("inserts", 1) * 0.5:
+                findings.append({
+                    "rule": "cache_thrash", "severity": "warning",
+                    "peers": [nid],
+                    "evidence": f"hit rate {rate:.0%} over {lookups} "
+                                f"lookups with {c['evictions']} "
+                                "evictions (budget below the working "
+                                "set)"})
+
+    def clock_skew() -> None:
+        # each snapshot's capture-time "now" vs the moment the
+        # coordinator RECEIVED that snapshot (stamped per-response in
+        # doctor_report), so a hung peer stalling the fan-out cannot
+        # make every fast answer look skewed. coordinator_now is only
+        # the fallback for snapshots without a receive stamp
+        # (unit-built dicts). Rough: RTT not subtracted — the threshold
+        # absorbs it.
+        for nid, snap in sorted(live.items()):
+            now = snap.get("now")
+            if now is None:
+                continue
+            skew = now - snap.get("receivedAt", coordinator_now)
+            if abs(skew) > CLOCK_SKEW_S:
+                findings.append({
+                    "rule": "clock_skew", "severity": "warning",
+                    "peers": [nid],
+                    "evidence": f"clock {skew:+.1f}s vs coordinator "
+                                "(LWW tombstone ordering rides wall "
+                                "clocks)"})
+
+    def config_drift() -> None:
+        hashes: dict[str, list[int]] = {}
+        for nid, snap in sorted(live.items()):
+            h = snap.get("configHash")
+            if h:
+                hashes.setdefault(str(h), []).append(nid)
+        if len(hashes) > 1:
+            groups = "; ".join(f"{h[:12]}…: nodes {nids}"
+                               for h, nids in sorted(hashes.items()))
+            findings.append({"rule": "config_drift",
+                             "severity": "warning",
+                             "peers": sorted(n for ns in hashes.values()
+                                             for n in ns),
+                             "evidence": "distinct config fingerprints "
+                                         f"— {groups}"})
+
+    def loop_lag() -> None:
+        # windowed sentinel evidence: maxLagS is a lifetime max, so one
+        # historical spike would latch this finding forever (same
+        # rationale as shed_storm); recentMaxLagS covers the sentinel's
+        # RECENT_WINDOW_S, with the lifetime max as the old-build
+        # fallback.
+        for nid, snap in sorted(live.items()):
+            sent = snap.get("sentinel") or {}
+            if not sent.get("enabled"):
+                continue
+            lag = sent.get("recentMaxLagS", sent.get("maxLagS", 0.0))
+            if lag >= sent.get("lagThresholdS", float("inf")):
+                findings.append({
+                    "rule": "loop_lag", "severity": "warning",
+                    "peers": [nid],
+                    "evidence": "recent event-loop lag up to "
+                                f"{lag:.3f}s"
+                                f" ({sent.get('incidents', 0)} incidents"
+                                " since boot — see its /events journal)"})
+
+    for rule in (dead_peer, slow_peer, shed_storm, credit_starvation,
+                 cache_thrash, clock_skew, config_drift, loop_lag):
+        try:
+            rule()
+        except Exception as e:   # noqa: BLE001 — see docstring
+            findings.append({
+                "rule": "doctor_error", "severity": "info", "peers": [],
+                "evidence": f"rule {rule.__name__} crashed on malformed "
+                            f"snapshot data ({e!r}) — findings above "
+                            "from it may be partial"})
+
+    order = {"critical": 0, "warning": 1, "info": 2}
+    findings.sort(key=lambda f: (order.get(f["severity"], 9), f["rule"]))
+    return findings
+
+
+def render_report(report: dict) -> str:
+    """Plain-text doctor report for the ``doctor`` CLI subcommand."""
+    nodes = report.get("nodes") or {}
+    lines = [f"cluster doctor — {len(nodes)} node(s) queried, "
+             f"{report.get('peersFailed', 0)} unreachable"]
+    for nid in sorted(nodes, key=int):
+        snap = nodes[nid]
+        if not snap:
+            lines.append(f"  node {nid}: NO ANSWER")
+            continue
+        disk = snap.get("disk") or {}
+        free = disk.get("freeBytes")
+        sent = snap.get("sentinel") or {}
+        inc = len(snap.get("incidents") or [])
+        lines.append(
+            f"  node {nid}: chunks={snap.get('chunks', '?')} "
+            f"files={snap.get('files', '?')} "
+            + (f"diskFree={free / 2**30:.1f}GiB " if free is not None
+               else "")
+            + f"maxLag={sent.get('maxLagS', 0.0):.3f}s "
+            f"incidents={inc} cfg={str(snap.get('configHash', ''))[:12]}")
+    findings = report.get("findings") or []
+    if not findings:
+        lines.append("no pathology detected")
+    for f in findings:
+        lines.append(f"! [{f['severity']}] {f['rule']} "
+                     f"(node(s) {f['peers']}): {f['evidence']}")
+    return "\n".join(lines)
+
+
+__all__ = ["diagnose", "render_report"]

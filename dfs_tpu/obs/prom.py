@@ -1,4 +1,14 @@
-"""Prometheus text exposition (format 0.0.4) for ``GET /metrics?format=prom``.
+"""OpenMetrics text exposition for ``GET /metrics?format=prom``.
+
+Served as ``application/openmetrics-text`` (not classic
+``text/plain; version=0.0.4``) because the histogram bucket lines carry
+exemplar suffixes — syntax that exists only in OpenMetrics; a classic
+0.0.4 parser would reject the whole scrape on the first exemplar.
+Prometheus picks its parser off the response Content-Type, so stock
+scrapers handle the page (exemplars included) with no configuration.
+OpenMetrics obligations honored here: counter ``# TYPE`` lines name the
+family WITHOUT the ``_total`` suffix (samples keep it), every family's
+samples are contiguous under its metadata, and the page ends ``# EOF``.
 
 Flattens every metric registry the node owns into one scrapeable page:
 
@@ -37,6 +47,19 @@ def _fmt(v: float) -> str:
     return repr(v) if isinstance(v, float) else str(v)
 
 
+def _exemplar(ex: tuple[str, float, float] | None) -> str:
+    """OpenMetrics exemplar suffix for a histogram bucket line —
+    `` # {trace_id="…"} <observed seconds> <unix ts>`` — linking the
+    bucket to the last trace that landed in it (absent when no traced
+    observation ever did). Legal syntax ONLY because the page is served
+    with the OpenMetrics content type (see module docstring)."""
+    if ex is None:
+        return ""
+    tid, val, ts = ex
+    return (f' # {{trace_id="{_esc(tid)}"}} {_fmt(float(val))}'
+            f' {_fmt(round(float(ts), 3))}')
+
+
 def render_node_metrics(node) -> str:
     """One node's full Prometheus page. ``node`` is the
     StorageNodeServer (duck-typed: counters / ingest_stalls / latency /
@@ -44,6 +67,12 @@ def render_node_metrics(node) -> str:
     lines: list[str] = []
 
     def fam(name: str, mtype: str) -> None:
+        # OpenMetrics metadata names the FAMILY; counter samples carry
+        # _total ON TOP of it, so the TYPE line must not include the
+        # suffix (a strict OM parser reading "# TYPE foo_total counter"
+        # would demand samples named foo_total_total).
+        if mtype == "counter" and name.endswith("_total"):
+            name = name[: -len("_total")]
         lines.append(f"# TYPE {name} {mtype}")
 
     counters = node.counters.snapshot()
@@ -65,21 +94,25 @@ def render_node_metrics(node) -> str:
             lines.append(f'dfs_peak{{name="{_esc(k)}"}} {_fmt(peaks[k])}')
 
     hists = node.latency.histogram_snapshot()
+    exemplars = node.latency.exemplar_snapshot()
     if hists:
         fam("dfs_latency_seconds", "histogram")
         for name in sorted(hists):
             buckets, count, total = hists[name]
+            ex = exemplars.get(name, {})
             lbl = f'name="{_esc(name)}"'
             acc = 0
-            for bound, c in zip(BUCKET_BOUNDS, buckets):
+            for i, (bound, c) in enumerate(zip(BUCKET_BOUNDS, buckets)):
                 acc += c
                 lines.append(f'dfs_latency_seconds_bucket'
-                             f'{{{lbl},le="{repr(bound)}"}} {acc}')
+                             f'{{{lbl},le="{repr(bound)}"}} {acc}'
+                             + _exemplar(ex.get(i)))
             # overflow bucket folds into +Inf; its cumulative count must
             # equal _count by construction
             acc += buckets[len(BUCKET_BOUNDS)]
             lines.append(f'dfs_latency_seconds_bucket'
-                         f'{{{lbl},le="+Inf"}} {acc}')
+                         f'{{{lbl},le="+Inf"}} {acc}'
+                         + _exemplar(ex.get(len(BUCKET_BOUNDS))))
             lines.append(f'dfs_latency_seconds_sum{{{lbl}}} {_fmt(total)}')
             lines.append(f'dfs_latency_seconds_count{{{lbl}}} {count}')
 
@@ -118,4 +151,21 @@ def render_node_metrics(node) -> str:
     lines.append(f'dfs_trace_spans {obs["spans"]}')
     fam("dfs_trace_ring_capacity", "gauge")
     lines.append(f'dfs_trace_ring_capacity {obs["traceRing"]}')
+    fam("dfs_trace_tail_spans", "gauge")
+    lines.append(f'dfs_trace_tail_spans {obs["tailSpans"]}')
+    journal = obs.get("journal") or {}
+    if journal.get("enabled"):
+        fam("dfs_journal_events_total", "counter")
+        lines.append(f'dfs_journal_events_total {journal["emitted"]}')
+        fam("dfs_journal_dropped_total", "counter")
+        lines.append(f'dfs_journal_dropped_total {journal["dropped"]}')
+    sentinel = obs.get("sentinel") or {}
+    if sentinel.get("enabled"):
+        fam("dfs_sentinel_incidents_total", "counter")
+        lines.append(
+            f'dfs_sentinel_incidents_total {sentinel["incidents"]}')
+        fam("dfs_loop_lag_seconds", "gauge")
+        lines.append(
+            f'dfs_loop_lag_seconds {_fmt(sentinel["lastLagS"])}')
+    lines.append("# EOF")   # OpenMetrics required terminator
     return "\n".join(lines) + "\n"

@@ -17,20 +17,42 @@ trace must degrade to a forest, never to silence.
 from __future__ import annotations
 
 
+def _dup_rank(sp: dict) -> tuple:
+    """Total order over duplicate records of one span id: prefer the
+    errored record, then the longer one, then the earlier start, then
+    the smaller node — so which duplicate survives depends only on the
+    records, never on the order peers answered a stitch query."""
+    return (bool(sp.get("err")), sp.get("d", 0.0),
+            -(sp.get("t0") or 0.0), -_node_key(sp))
+
+
+def _node_key(sp: dict) -> float:
+    node = sp.get("node")
+    return float(node) if isinstance(node, (int, float)) \
+        and not isinstance(node, bool) else float("inf")
+
+
 def merge_spans(span_lists) -> list[dict]:
-    """Concatenate per-node span lists, dropping duplicates (a span is
-    unique by (node, span_id) — a retried stitch query may see the same
-    ring entry twice)."""
-    seen: set[tuple] = set()
-    out: list[dict] = []
+    """Concatenate per-node span lists, deduping by span id. Exact
+    duplicates (a retried stitch query seeing the same ring entry twice,
+    or a node's tail store and ring both answering) collapse trivially;
+    CONFLICTING records under one id (a retried RPC that executed twice,
+    a buggy peer) dedup deterministically via :func:`_dup_rank` — the
+    stitched tree must not depend on peer answer order."""
+    best: dict[str, dict] = {}
+    order: list[str] = []
     for spans in span_lists:
         for sp in spans or []:
-            key = (sp.get("node"), sp.get("s"))
-            if key in seen:
-                continue
-            seen.add(key)
-            out.append(sp)
-    return out
+            sid = sp.get("s")
+            if sid is None:
+                continue   # no identity: cannot participate in a tree
+            cur = best.get(sid)
+            if cur is None:
+                best[sid] = sp
+                order.append(sid)
+            elif _dup_rank(sp) > _dup_rank(cur):
+                best[sid] = sp
+    return [best[sid] for sid in order]
 
 
 def _fmt_bytes(n: int) -> str:
@@ -63,15 +85,22 @@ def render_tree(spans: list[dict], slow_s: float = 1.0) -> str:
     by_id = {sp.get("s"): sp for sp in spans}
     children: dict[str | None, list[dict]] = {}
     roots: list[dict] = []
+    orphans: list[dict] = []
     for sp in spans:
         parent = sp.get("p")
-        if parent is not None and parent in by_id:
+        if parent is None:
+            roots.append(sp)                       # true root
+        elif parent in by_id and parent != sp.get("s"):
             children.setdefault(parent, []).append(sp)
         else:
-            roots.append(sp)   # true root, or parent missing/evicted
+            # parent never arrived (evicted ring entry, dead node) or a
+            # degenerate self-parent: attach under the synthetic root
+            # below rather than silently flattening into the real roots
+            orphans.append(sp)
     for lst in children.values():
         lst.sort(key=lambda s: s.get("t0", 0.0))
     roots.sort(key=lambda s: s.get("t0", 0.0))
+    orphans.sort(key=lambda s: s.get("t0", 0.0))
 
     nodes = sorted({sp.get("node") for sp in spans})
     t0 = min(sp.get("t0", 0.0) for sp in spans)
@@ -85,14 +114,35 @@ def render_tree(spans: list[dict], slow_s: float = 1.0) -> str:
         out.append(f"slow spans (>= {slow_s:g}s):")
         out.extend(f"  ! {_line(sp)}" for sp in slow)
 
+    emitted: set[str] = set()
+
     def walk(sp: dict, prefix: str, last: bool) -> None:
+        sid = sp.get("s")
+        if sid in emitted:
+            return               # cycle guard: a span renders once
+        emitted.add(sid)
         branch = "└─ " if last else "├─ "
         out.append(prefix + branch + _line(sp))
-        kids = children.get(sp.get("s"), [])
+        kids = children.get(sid, [])
         ext = "   " if last else "│  "
         for i, kid in enumerate(kids):
             walk(kid, prefix + ext, i == len(kids) - 1)
 
+    last_root = not orphans
     for i, root in enumerate(roots):
-        walk(root, "", i == len(roots) - 1)
+        walk(root, "", last_root and i == len(roots) - 1)
+    # synthetic root for orphans — and for anything a parent CYCLE made
+    # unreachable from any root: an incomplete or malformed trace must
+    # degrade to a labeled forest, never drop spans silently
+    orphan_ids = {id(sp) for sp in orphans}   # identity, not equality:
+    # `sp in orphans` is a quadratic scan AND aliases equal-content
+    # duplicate spans
+    stray = [sp for sp in orphans + [s for s in spans
+                                     if id(s) not in orphan_ids]
+             if sp.get("s") not in emitted]
+    if stray:
+        out.append("└─ (orphaned — parent evicted, never arrived, or "
+                   "cyclic)")
+        for i, sp in enumerate(stray):
+            walk(sp, "   ", i == len(stray) - 1)
     return "\n".join(out)

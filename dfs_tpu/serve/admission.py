@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import time
 
 import asyncio
 
@@ -37,6 +38,14 @@ class AdmissionGate:
     """One request class's gate: up to ``slots`` concurrent holders, up
     to ``queue_depth`` waiters, shed beyond that."""
 
+    # recency window for ``stats()["shedRecent"]`` — the doctor's
+    # shed_storm rule reads it so one historical overload cannot latch
+    # the diagnosis red forever (``shed`` itself is since-boot). The
+    # deque bound caps memory under a storm; a window holding 256+
+    # sheds reads as "storm" regardless of the exact count.
+    SHED_WINDOW_S = 60.0
+    _SHED_TS_MAX = 256
+
     def __init__(self, name: str, slots: int, queue_depth: int,
                  retry_after_s: float = 1.0, obs=None) -> None:
         self.name = name
@@ -54,6 +63,8 @@ class AdmissionGate:
         self.admitted = 0
         self.queued = 0
         self.shed = 0
+        self._shed_ts: collections.deque[float] = \
+            collections.deque(maxlen=self._SHED_TS_MAX)
 
     @property
     def enabled(self) -> bool:
@@ -71,6 +82,13 @@ class AdmissionGate:
         waiting = sum(1 for f in self._queue if not f.done())
         if waiting >= self.queue_depth:
             self.shed += 1
+            self._shed_ts.append(time.monotonic())
+            if self._obs is not None:
+                # flight-recorder evidence for the doctor's shed_storm
+                # rule — sheds during an overload are exactly the events
+                # that vanish with the process
+                self._obs.event("shed", cls=self.name,
+                                active=self._active, waiting=waiting)
             raise ShedError(self.name, self.retry_after_s)
         fut = asyncio.get_running_loop().create_future()
         self._queue.append(fut)
@@ -111,11 +129,13 @@ class AdmissionGate:
             self.release()
 
     def stats(self) -> dict:
+        cutoff = time.monotonic() - self.SHED_WINDOW_S
         return {"slots": self.slots, "queueDepth": self.queue_depth,
                 "active": self._active,
                 "waiting": sum(1 for f in self._queue if not f.done()),
                 "admitted": self.admitted, "queuedTotal": self.queued,
-                "shed": self.shed}
+                "shed": self.shed,
+                "shedRecent": sum(1 for t in self._shed_ts if t >= cutoff)}
 
 
 class AdmissionControl:

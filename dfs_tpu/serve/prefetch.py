@@ -78,5 +78,7 @@ class BatchPrefetcher:
         for t in tasks:
             try:
                 await t
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass    # teardown: failures already mooted by abandonment
+            # teardown: the consumer abandoned the stream, so a fetch
+            # failure has no one left to tell — deliberately silent
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001  # dfslint: ignore[DFS007]
+                pass

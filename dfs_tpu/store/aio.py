@@ -73,12 +73,18 @@ class AsyncChunkStore:
         self._ops = 0
         self._queue_s = 0.0
         self._busy_s = 0.0
+        self._pending = 0   # submitted, not yet finished — the backlog
+        # gauge the runtime sentinel samples (obs/sentinel.py): a value
+        # persistently above the worker count means the disk tier is
+        # saturated and callers are queueing
 
     async def _run(self, pool: ThreadPoolExecutor,
                    fn: Callable[[], T], opname: str | None = None) -> T:
         import asyncio
 
         t_submit = time.perf_counter()
+        with self._lock:
+            self._pending += 1
 
         def job() -> T:
             t_start = time.perf_counter()
@@ -88,14 +94,23 @@ class AsyncChunkStore:
                 t_end = time.perf_counter()
                 with self._lock:
                     self._ops += 1
+                    self._pending -= 1
                     self._queue_s += t_start - t_submit
                     self._busy_s += t_end - t_start
 
         loop = asyncio.get_running_loop()
+        try:
+            fut = loop.run_in_executor(pool, job)
+        except BaseException:
+            # submit failed (pool shut down): the job will never run its
+            # finally, so the backlog gauge must be unwound here
+            with self._lock:
+                self._pending -= 1
+            raise
         if self._obs is None or opname is None:
-            return await loop.run_in_executor(pool, job)
+            return await fut
         with self._obs.span(opname):
-            return await loop.run_in_executor(pool, job)
+            return await fut
 
     async def get(self, digest: str) -> bytes | None:
         return await self._run(self._gpool,
@@ -132,9 +147,16 @@ class AsyncChunkStore:
             lambda: [self.store.put(d, b, verify=verify) for d, b in its],
             "cas.put_many")
 
+    @property
+    def pending(self) -> int:
+        """Jobs submitted but not yet finished (queued + running)."""
+        with self._lock:
+            return self._pending
+
     def stats(self) -> dict:
         with self._lock:
             return {"workers": self._workers, "ops": self._ops,
+                    "pending": self._pending,
                     "queueS": round(self._queue_s, 6),
                     "busyS": round(self._busy_s, 6)}
 

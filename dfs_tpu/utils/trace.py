@@ -39,9 +39,19 @@ class LatencyRecorder:
         self._lock = threading.Lock()
         self._hist: dict[str, list[int]] = {}
         self._stats: dict[str, tuple[int, float, float]] = {}  # n, sum, max
+        # name -> {bucket index -> (trace_id, observed seconds, wall ts)}:
+        # the LAST traced observation that landed in each bucket — the
+        # OpenMetrics exemplar convention linking a histogram bucket to
+        # the one concrete request that produced it. Bounded by
+        # construction: <= _MAX_NAMES names x (len(_BOUNDS)+1) buckets.
+        self._ex: dict[str, dict[int, tuple[str, float, float]]] = {}
         self._overflow_warned = False
 
-    def record(self, name: str, seconds: float) -> None:
+    def record(self, name: str, seconds: float,
+               exemplar: str | None = None) -> None:
+        """Record one observation; ``exemplar`` (a trace id) tags the
+        bucket it lands in so the Prometheus exposition can link the
+        bucket straight to ``trace <id>``."""
         idx = bisect.bisect_left(_BOUNDS, seconds)
         with self._lock:
             name = capped_key(self._hist, name, self._MAX_NAMES, self,
@@ -50,6 +60,9 @@ class LatencyRecorder:
             h[min(idx, len(_BOUNDS))] += 1
             n, s, mx = self._stats.get(name, (0, 0.0, 0.0))
             self._stats[name] = (n + 1, s + seconds, max(mx, seconds))
+            if exemplar is not None:
+                self._ex.setdefault(name, {})[min(idx, len(_BOUNDS))] = (
+                    exemplar, seconds, time.time())
 
     def _quantile(self, h: list[int], q: float, total: int) -> float:
         """Bucket-estimated quantile: the GEOMETRIC MIDPOINT of the
@@ -98,6 +111,14 @@ class LatencyRecorder:
             return {name: (list(h), self._stats[name][0],
                            self._stats[name][1])
                     for name, h in self._hist.items()}
+
+    def exemplar_snapshot(self
+                          ) -> dict[str, dict[int, tuple[str, float, float]]]:
+        """name -> {bucket index -> (trace_id, seconds, wall ts)} — the
+        last traced observation per bucket, for OpenMetrics exemplar
+        exposition (indices align with histogram_snapshot buckets)."""
+        with self._lock:
+            return {name: dict(ex) for name, ex in self._ex.items()}
 
 
 # Set only while device_trace() is active. span() consults this flag instead

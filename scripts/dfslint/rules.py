@@ -294,9 +294,18 @@ _SERVE_METRIC_KEYS = {"cache_bytes": "cache",
                       "queue_depth": "admission",
                       "retry_after_s": "admission"}
 # observability knobs surface under /metrics "obs"
-# (dfs_tpu/obs/__init__.py Observability.stats())
+# (dfs_tpu/obs/__init__.py Observability.stats()). The journal and
+# sentinel fields ride their nested sub-sections ("journal" carries
+# bytes/segmentBytes from journal.stats(); "sentinel" carries
+# intervalS/lagThresholdS from sentinel.stats()) — same nesting
+# convention as IngestConfig.cas_io_threads -> "cas".
 _OBS_METRIC_KEYS = {"trace_ring": "traceRing",
-                    "slow_span_s": "slowSpanS"}
+                    "slow_span_s": "slowSpanS",
+                    "tail_keep": "tailKeep",
+                    "journal_bytes": "journal",
+                    "journal_segment_bytes": "journal",
+                    "sentinel_interval_s": "sentinel",
+                    "sentinel_lag_s": "sentinel"}
 
 
 def _dataclass_fields(src: SourceFile) -> dict[str, dict[str, int]]:
@@ -536,6 +545,99 @@ def check_copy_discipline(project: Project) -> Iterator[Finding]:
 
 
 # ------------------------------------------------------------------ #
+# DFS007 — no silent swallow of failure-class exceptions
+# ------------------------------------------------------------------ #
+
+# the trees where a silently-eaten failure costs diagnosis: the data
+# plane and node runtime. api/ answers the client (the error IS the
+# signal there), cli/ is interactive, fragmenter/ops are compute.
+_SWALLOW_SCOPE = ("dfs_tpu/comm/", "dfs_tpu/node/", "dfs_tpu/serve/",
+                  "dfs_tpu/store/")
+# exception names (last dotted component) that signal a FAILURE when
+# caught — transport errors, broad catches, and the repo's own error
+# classes. Absence-as-result types (FileNotFoundError, KeyError,
+# queue.Empty, …) are normal control flow and are deliberately NOT
+# listed: swallowing them is how optional lookups are written.
+_FAILURE_EXCS = frozenset({
+    "Exception", "BaseException", "RuntimeError", "OSError", "IOError",
+    "ConnectionError", "TimeoutError", "RpcError", "RpcUnreachable",
+    "RpcRemoteError", "WireError", "DownloadError", "UploadError",
+    "ShedError",
+})
+# calls inside a handler that count as "the failure left a trace":
+# logging, the flight-recorder journal, a metrics counter, liveness
+# feedback (mark_dead/mark_alive transitions are themselves journaled
+# and logged), or propagating to waiters (singleflight reject /
+# future.set_exception)
+_HANDLE_LOG_ATTRS = frozenset({"debug", "info", "warning", "error",
+                               "exception", "critical"})
+_HANDLE_EVIDENCE_ATTRS = frozenset({"inc", "event", "emit", "mark_dead",
+                                    "mark_alive", "reject",
+                                    "set_exception"})
+
+
+def _catches_failure(handler: ast.ExceptHandler) -> str | None:
+    """The failure-class name this handler catches, or None when every
+    caught type is an absence-as-result type (or the handler is too
+    dynamic to judge)."""
+    t = handler.type
+    if t is None:
+        return "bare except"
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [dotted(e) for e in t.elts]
+    else:
+        names = [dotted(t)]
+    for name in names:
+        if name and name.split(".")[-1] in _FAILURE_EXCS:
+            return name
+    return None
+
+
+def _handler_leaves_trace(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr in _HANDLE_LOG_ATTRS \
+                    or attr in _HANDLE_EVIDENCE_ATTRS:
+                return True
+    return False
+
+
+def check_silent_swallow(project: Project) -> Iterator[Finding]:
+    """A caught transport/failure-class exception must leave a trace —
+    log, journal event, metrics counter, liveness feedback, waiter
+    propagation, or re-raise. An ``except RpcError: pass`` in the data
+    plane turns a sick link into silence; the flight recorder
+    (obs/journal.py) exists precisely so these moments survive the
+    process. Deliberately-silent handlers (best-effort probes whose
+    failure is metered one layer down) carry an inline
+    ``# dfslint: ignore[DFS007]`` naming their reason."""
+    for src in project.files:
+        if src.tree is None:
+            continue
+        if not any(src.rel.startswith(p) or f"/{p}" in src.rel
+                   for p in _SWALLOW_SCOPE):
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            caught = _catches_failure(node)
+            if caught is None or _handler_leaves_trace(node):
+                continue
+            yield Finding(
+                "DFS007", "error", src.rel, node.lineno, node.col_offset,
+                f"`except {caught}` swallows a failure-class exception "
+                "with no trace — log it, journal it (obs.event), count "
+                "it, or re-raise; a justified silent handler carries an "
+                "inline ignore with its reason",
+                f"{src.qualname(node)}:swallow-{caught}")
+
+
+# ------------------------------------------------------------------ #
 # registry
 # ------------------------------------------------------------------ #
 
@@ -546,6 +648,8 @@ ALL_RULES = (
     ("DFS004", "digest outside utils/hashing + ops", check_digest_boundary),
     ("DFS005", "CLI/config//metrics drift", check_config_drift),
     ("DFS006", "data-plane copy discipline", check_copy_discipline),
+    ("DFS007", "silent swallow of failure exceptions",
+     check_silent_swallow),
 )
 
 

@@ -353,6 +353,113 @@ def test_dfs006_true_negatives(tmp_path):
 
 
 # ------------------------------------------------------------------ #
+# DFS007 — silent swallow of failure-class exceptions
+# ------------------------------------------------------------------ #
+
+def test_dfs007_true_positives(tmp_path):
+    src = (
+        "class C:\n"
+        "    async def probe(self, peer):\n"
+        "        try:\n"
+        "            await self.client.call(peer, {})\n"
+        "        except RpcError:\n"
+        "            pass\n"
+        "    def read(self, p):\n"
+        "        try:\n"
+        "            return open(p).read()\n"
+        "        except OSError:\n"
+        "            return None\n"
+        "    def any_at_all(self):\n"
+        "        try:\n"
+        "            self.work()\n"
+        "        except:\n"
+        "            pass\n")
+    found = lint(tmp_path, {"dfs_tpu/comm/rpc.py": src})
+    assert rules_of(found) == ["DFS007"] * 3
+    assert "swallow-RpcError" in found[0].context
+    assert "swallow-bare except" in found[2].context
+
+
+def test_dfs007_scoped_to_data_plane_and_runtime(tmp_path):
+    """The same silence outside comm//node//serve//store is fine — in
+    api/ the error response IS the signal, cli/ is interactive."""
+    src = ("def f(self):\n"
+           "    try:\n"
+           "        self.work()\n"
+           "    except OSError:\n"
+           "        pass\n")
+    assert lint(tmp_path / "a", {"dfs_tpu/api/http.py": src}) == []
+    assert lint(tmp_path / "b", {"dfs_tpu/cli/main.py": src}) == []
+    assert rules_of(lint(tmp_path / "c",
+                         {"dfs_tpu/store/cas.py": src})) == ["DFS007"]
+
+
+def test_dfs007_evidence_forms_are_clean(tmp_path):
+    """Every sanctioned way of leaving a trace: log, journal event,
+    counter, liveness transition, waiter propagation, re-raise."""
+    found = lint(tmp_path, {"dfs_tpu/node/runtime.py": (
+        "class C:\n"
+        "    async def a(self, peer):\n"
+        "        try:\n"
+        "            await self.client.call(peer, {})\n"
+        "        except RpcError:\n"
+        "            self.log.warning('x')\n"
+        "    async def b(self, peer):\n"
+        "        try:\n"
+        "            await self.client.call(peer, {})\n"
+        "        except RpcError:\n"
+        "            self.obs.event('rpc_fail', peer=1)\n"
+        "    async def c(self, peer):\n"
+        "        try:\n"
+        "            await self.client.call(peer, {})\n"
+        "        except RpcError:\n"
+        "            self.counters.inc('probe_failures')\n"
+        "    async def d(self, peer):\n"
+        "        try:\n"
+        "            await self.client.call(peer, {})\n"
+        "        except RpcUnreachable:\n"
+        "            self.health.mark_dead(peer.node_id)\n"
+        "    async def e(self, fut):\n"
+        "        try:\n"
+        "            await self.run()\n"
+        "        except OSError as exc:\n"
+        "            fut.set_exception(exc)\n"
+        "    async def f(self):\n"
+        "        try:\n"
+        "            await self.run()\n"
+        "        except OSError:\n"
+        "            raise RuntimeError('ctx')\n")})
+    assert found == []
+
+
+def test_dfs007_absence_as_result_types_are_clean(tmp_path):
+    """FileNotFoundError/KeyError/queue.Empty et al are control flow —
+    swallowing them is how optional lookups are written."""
+    found = lint(tmp_path, {"dfs_tpu/store/cas.py": (
+        "import queue\n"
+        "def f(self, p, q):\n"
+        "    try:\n"
+        "        return open(p).read()\n"
+        "    except FileNotFoundError:\n"
+        "        pass\n"
+        "    try:\n"
+        "        return q.get_nowait()\n"
+        "    except queue.Empty:\n"
+        "        return None\n")})
+    assert found == []
+
+
+def test_dfs007_inline_ignore(tmp_path):
+    found = lint(tmp_path, {"dfs_tpu/store/cas.py": (
+        "def f(self, p):\n"
+        "    try:\n"
+        "        return open(p).read()\n"
+        "    except OSError:  # dfslint: ignore[DFS007]\n"
+        "        return None\n")})
+    assert found == []
+
+
+# ------------------------------------------------------------------ #
 # suppressions, baseline, walker, parse errors
 # ------------------------------------------------------------------ #
 

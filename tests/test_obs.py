@@ -85,25 +85,36 @@ def _req(port: int, method: str, path: str, body=None, headers=None):
 _SAMPLE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
 _LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+# OpenMetrics exemplar suffix: `` # {labels} value [timestamp]``
+_EXEMPLAR = re.compile(
+    r'^\{trace_id="([0-9a-f]{32})"\} (\S+)(?: (\S+))?$')
 
 
 def parse_prom(text: str):
-    """-> (samples, types): samples maps (metric name, sorted label
-    tuple) -> float; types maps family -> declared type. Raises
+    """-> (samples, types, exemplars): samples maps (metric name, sorted
+    label tuple) -> float; types maps family -> declared type; exemplars
+    maps a sample key to its (trace_id, value) exemplar. Raises
     AssertionError on any malformed line, on a family declared twice,
-    or on a family whose samples are not CONTIGUOUS (the exposition
-    format's grouping rule — strict parsers reject interleaving)."""
-    samples, types = {}, {}
+    on a family whose samples are not CONTIGUOUS (the exposition
+    format's grouping rule — strict parsers reject interleaving), on a
+    malformed exemplar, on an exemplar outside a bucket line, or on a
+    page missing the OpenMetrics ``# EOF`` terminator. Counter TYPE
+    lines name the family without ``_total`` (OpenMetrics); samples
+    carry the suffix."""
+    samples, types, exemplars = {}, {}, {}
     done_families, cur_family = set(), None
+    assert text.endswith("# EOF\n"), "missing OpenMetrics # EOF terminator"
 
     def family(name: str) -> str:
-        for suffix in ("_bucket", "_sum", "_count"):
+        for suffix in ("_bucket", "_sum", "_count", "_total"):
             if name.endswith(suffix) and name[:-len(suffix)] in types:
                 return name[:-len(suffix)]
         return name
 
     for line in text.splitlines():
         if not line.strip():
+            continue
+        if line == "# EOF":
             continue
         if line.startswith("#"):
             parts = line.split()
@@ -113,9 +124,17 @@ def parse_prom(text: str):
                     f"family {parts[2]} declared twice"
                 types[parts[2]] = parts[3]
             continue
+        ex = None
+        if " # " in line:       # exemplar suffix (OpenMetrics)
+            line, _, ex_text = line.partition(" # ")
+            em = _EXEMPLAR.match(ex_text)
+            assert em, f"malformed exemplar: {ex_text!r}"
+            ex = (em.group(1), float(em.group(2)))
         m = _SAMPLE.match(line)
         assert m, f"malformed prom sample line: {line!r}"
         name, labels, value = m.groups()
+        assert ex is None or name.endswith("_bucket"), \
+            f"exemplar outside a bucket line: {line!r}"
         fam = family(name)
         if fam != cur_family:
             assert fam not in done_families, \
@@ -132,7 +151,9 @@ def parse_prom(text: str):
         key = (name, lbl)
         assert key not in samples, f"duplicate sample {key}"
         samples[key] = v
-    return samples, types
+        if ex is not None:
+            exemplars[key] = ex
+    return samples, types, exemplars
 
 
 # --------------------------------------------------------------------- #
@@ -259,13 +280,211 @@ def test_admission_queue_wait_records_span():
 
 
 # --------------------------------------------------------------------- #
+# tail retention: outlier traces survive ring churn (r11)
+# --------------------------------------------------------------------- #
+
+def _churn(obs, n):
+    for _ in range(n):
+        with obs.request_span("http./status"):
+            pass
+
+
+def test_tail_keeps_errored_trace_across_ring_churn():
+    """The Dapper tail lesson: an ERRORED trace must still be
+    retrievable after enough ordinary traffic to evict it from the main
+    ring — and its pre-error spans (already in the ring when the error
+    landed) must be swept into the tail store with it."""
+    obs = Observability(ObsConfig(trace_ring=8, tail_keep=64), node_id=1)
+
+    async def failing_request():
+        with pytest.raises(ValueError):
+            with obs.request_span("http./download"):
+                with obs.span("download.gather"):   # ok, pre-error
+                    pass
+                with obs.span("cas.get"):
+                    raise ValueError("disk ate it")
+
+    asyncio.run(failing_request())
+    tid = obs._ring[-1][0]
+    _churn(obs, 50)                       # 50 ordinary traces >> ring 8
+    assert all(r[0] != tid for r in obs._ring), "churn must evict"
+    spans = obs.spans_for(tid)
+    names = {s["name"] for s in spans}
+    # the whole trace survived: the errored span AND its older siblings
+    assert names == {"http./download", "download.gather", "cas.get"}
+    assert next(s for s in spans if s["name"] == "cas.get")["err"] \
+        == "ValueError"
+    # ordinary churn traces did NOT get pinned
+    assert obs.stats()["tailSpans"] == 3
+
+
+def test_tail_keeps_slow_trace():
+    """slow_span_s is the outlier detector's threshold: any span at or
+    beyond it pins its trace (no error required)."""
+    obs = Observability(ObsConfig(trace_ring=4, tail_keep=16,
+                                  slow_span_s=1e-9), node_id=1)
+    with obs.request_span("http./upload"):       # every span is "slow"
+        pass
+    tid = obs._ring[-1][0]
+    obs2_cfg_default_not_slow = ObsConfig()      # sanity: default is 1s
+    assert obs2_cfg_default_not_slow.slow_span_s == 1.0
+    for _ in range(10):
+        with obs.request_span("http./status"):
+            pass
+    assert [s["name"] for s in obs.spans_for(tid)] == ["http./upload"]
+
+
+def test_tail_store_is_bounded_fifo():
+    obs = Observability(ObsConfig(trace_ring=4, tail_keep=3,
+                                  slow_span_s=1e-9), node_id=1)
+    tids = []
+    for _ in range(5):                   # every trace pins (all slow)
+        with obs.request_span("http./x"):
+            pass
+        tids.append(obs._ring[-1][0])
+    assert obs.stats()["tailSpans"] == 3
+    # FIFO: the oldest two pinned spans fell off the bounded tail (and
+    # the 4-deep main ring has churned past them too)
+    assert obs.spans_for(tids[0]) == []
+    assert obs.spans_for(tids[-1])       # newest survives
+
+
+def test_tail_off_by_config():
+    obs = Observability(ObsConfig(trace_ring=4, tail_keep=0), node_id=1)
+    with pytest.raises(ValueError):
+        with obs.request_span("http./x"):
+            raise ValueError("x")
+    tid = obs._ring[-1][0]
+    _churn(obs, 10)
+    assert obs.spans_for(tid) == []      # outliers evict like anyone
+    assert obs.stats()["tailSpans"] == 0
+
+
+# --------------------------------------------------------------------- #
+# exemplars (r11): histogram buckets carry the last trace id seen there
+# --------------------------------------------------------------------- #
+
+def test_latency_exemplar_snapshot_roundtrip():
+    from dfs_tpu.utils.trace import LatencyRecorder
+
+    rec = LatencyRecorder()
+    rec.record("download.gather", 0.010, exemplar="a" * 32)
+    rec.record("download.gather", 0.011, exemplar="b" * 32)  # same bucket
+    rec.record("download.gather", 5.0, exemplar="c" * 32)
+    rec.record("untraced.op", 0.010)                         # no exemplar
+    ex = rec.exemplar_snapshot()
+    assert "untraced.op" not in ex
+    got = ex["download.gather"]
+    by_tid = {tid: (idx, val) for idx, (tid, val, _ts) in got.items()}
+    assert "b" * 32 in by_tid            # last writer per bucket wins
+    assert "a" * 32 not in by_tid
+    assert "c" * 32 in by_tid
+    assert by_tid["b" * 32][1] == 0.011
+
+
+def test_prom_exemplar_exposition_format():
+    """Exemplar suffixes must parse under the strict in-repo parser and
+    sit only on bucket lines, linking the bucket to the trace id."""
+    from dfs_tpu.obs.prom import render_node_metrics
+
+    class FakeNode:
+        pass
+
+    obs = Observability(ObsConfig(trace_ring=8), node_id=1)
+
+    async def traced_read():
+        with obs.request_span("http./download"):
+            with obs.span("download.gather", latency=True):
+                pass
+
+    asyncio.run(traced_read())
+    tid = obs._ring[-1][0]
+    node = FakeNode()
+    node.counters = type("C", (), {"snapshot": staticmethod(dict)})()
+    node.ingest_stalls = type("S", (), {"snapshot": staticmethod(dict)})()
+    node.latency = obs.latency
+    node.obs = obs
+    node.under_replicated = set()
+    text = render_node_metrics(node)
+    samples, types, exemplars = parse_prom(text)
+    ex = [(key, e) for key, e in exemplars.items()
+          if dict(key[1]).get("name") == "download.gather"]
+    assert ex and all(e[0] == tid for _, e in ex)
+
+
+# --------------------------------------------------------------------- #
 # stitcher
 # --------------------------------------------------------------------- #
 
 def test_merge_spans_dedups():
     a = {"node": 1, "s": "aa", "t": "t", "name": "x", "t0": 0.0, "d": 1.0}
-    b = {"node": 2, "s": "aa", "t": "t", "name": "y", "t0": 0.0, "d": 1.0}
+    b = {"node": 1, "s": "ab", "t": "t", "name": "y", "t0": 0.0, "d": 1.0}
     assert len(merge_spans([[a], [a, b]])) == 2
+
+
+def test_merge_spans_duplicate_ids_dedup_deterministically():
+    """A retried RPC that executed twice yields two DIFFERENT records
+    under one span id; the survivor must not depend on which peer
+    answered first (r11 stitch hardening)."""
+    ok = {"node": 2, "s": "aa", "t": "t", "name": "peer.get_chunks",
+          "t0": 1.0, "d": 0.2}
+    errored = {"node": 3, "s": "aa", "t": "t", "name": "peer.get_chunks",
+               "t0": 1.1, "d": 0.1, "err": "TimeoutError"}
+    for order in ([[ok], [errored]], [[errored], [ok]],
+                  [[ok, errored]], [[errored, ok]]):
+        got = merge_spans(order)
+        assert len(got) == 1
+        assert got[0]["err"] == "TimeoutError"   # errored record wins
+    # same error status: the longer record wins, either order
+    long = dict(ok, d=0.9)
+    for order in ([[ok], [long]], [[long], [ok]]):
+        assert merge_spans(order)[0]["d"] == 0.9
+    # spans with no id cannot participate in a tree: dropped, not merged
+    assert merge_spans([[{"node": 1, "name": "x"}]]) == []
+
+
+def test_render_tree_orphans_attach_under_synthetic_root():
+    tid = "f" * 32
+    spans = [
+        {"t": tid, "s": "a" * 16, "p": None, "name": "http./download",
+         "node": 1, "t0": 0.0, "d": 0.5},
+        # parent never arrived (evicted / dead node)
+        {"t": tid, "s": "b" * 16, "p": "9" * 16, "name": "cas.get",
+         "node": 2, "t0": 0.2, "d": 0.05},
+        # child of the orphan: must nest under it, inside the synthetic
+        # root section
+        {"t": tid, "s": "c" * 16, "p": "b" * 16, "name": "cas.get.io",
+         "node": 2, "t0": 0.21, "d": 0.01},
+    ]
+    out = render_tree(spans, slow_s=1.0)
+    lines = out.splitlines()
+    orphan_hdr = next(i for i, ln in enumerate(lines) if "orphaned" in ln)
+    assert any("cas.get" in ln for ln in lines[orphan_hdr:])
+    # the true root renders BEFORE the synthetic root, not under it
+    assert any("http./download" in ln for ln in lines[:orphan_hdr])
+    # child nests under the orphan inside the synthetic section
+    o_line = next(i for i, ln in enumerate(lines) if "cas.get " in ln
+                  or ln.endswith("cas.get"))
+    c_line = next(i for i, ln in enumerate(lines) if "cas.get.io" in ln)
+    assert c_line > o_line >= orphan_hdr
+
+
+def test_render_tree_cycles_terminate_and_render_once():
+    """Degenerate parent links (self-parent, 2-cycles from byzantine
+    duplicates) must neither hang nor drop spans silently."""
+    tid = "e" * 32
+    spans = [
+        {"t": tid, "s": "a" * 16, "p": "a" * 16, "name": "self.loop",
+         "node": 1, "t0": 0.0, "d": 0.1},
+        {"t": tid, "s": "b" * 16, "p": "c" * 16, "name": "cycle.one",
+         "node": 1, "t0": 0.1, "d": 0.1},
+        {"t": tid, "s": "c" * 16, "p": "b" * 16, "name": "cycle.two",
+         "node": 1, "t0": 0.2, "d": 0.1},
+    ]
+    out = render_tree(spans, slow_s=10.0)
+    for name in ("self.loop", "cycle.one", "cycle.two"):
+        assert out.count(name) == 1, f"{name} dropped or duplicated"
+    assert "orphaned" in out
 
 
 def test_render_tree_structure_and_slow_log():
@@ -293,6 +512,542 @@ def test_render_tree_structure_and_slow_log():
     assert "cas.get" in out                     # orphan not silenced
     assert "2.0KiB" in out
     assert render_tree([], 1.0).startswith("(no spans")
+
+
+# --------------------------------------------------------------------- #
+# flight recorder (obs/journal.py)
+# --------------------------------------------------------------------- #
+
+def test_journal_roundtrip_and_trace_stamp(tmp_path):
+    from dfs_tpu.obs.journal import Journal, read_events
+
+    j = Journal(tmp_path / "j", node_id=3)
+    try:
+        j.emit("peer_down", {"peer": 2})
+        j.emit("shed", {"cls": "download"}, trace="a" * 32)
+        j.flush()
+        events, torn = read_events(tmp_path / "j")
+        assert torn == 0
+        assert [e["type"] for e in events] == ["peer_down", "shed"]
+        assert events[0]["node"] == 3 and events[0]["peer"] == 2
+        assert events[1]["trace"] == "a" * 32
+        assert "trace" not in events[0]
+        assert events[0]["ts"] <= events[1]["ts"]
+        # since/limit: newest N at or after the bound
+        ev2, _ = read_events(tmp_path / "j", limit=1)
+        assert [e["type"] for e in ev2] == ["shed"]
+        ev3, _ = read_events(tmp_path / "j", since=events[1]["ts"])
+        assert {e["type"] for e in ev3} <= {"peer_down", "shed"}
+    finally:
+        j.close()
+
+
+def test_journal_rotation_and_budget(tmp_path):
+    from dfs_tpu.obs.journal import Journal, read_events
+
+    root = tmp_path / "j"
+    j = Journal(root, node_id=1, total_bytes=4096, segment_bytes=512)
+    try:
+        for i in range(200):                    # ~60B each >> budget
+            j.emit("tick", {"i": i})
+        j.flush()
+        # flush drains the queue; the final in-flight write needs one
+        # more beat — poll briefly for the invariant instead of sleeping
+        import time as _time
+
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline:
+            segs = list(root.glob("events-*.jsonl"))
+            if len(segs) >= 2 and sum(p.stat().st_size
+                                      for p in segs) <= 4096 + 512 + 256:
+                break
+            _time.sleep(0.01)
+        segs = sorted(root.glob("events-*.jsonl"))
+        assert len(segs) >= 2, "no rotation happened"
+        # total disk stays within budget + one segment + one record
+        assert sum(p.stat().st_size for p in segs) <= 4096 + 512 + 256
+        events, torn = read_events(root, limit=4096)
+        assert torn == 0
+        # newest events survive; oldest were rotated away
+        assert events[-1]["i"] == 199
+        assert events[0]["i"] > 0
+        idx = [e["i"] for e in events]
+        assert idx == sorted(idx)               # oldest-first, in order
+    finally:
+        j.close()
+
+
+def test_journal_torn_tail_discarded_not_fatal(tmp_path):
+    from dfs_tpu.obs.journal import Journal, read_events
+
+    root = tmp_path / "j"
+    j = Journal(root, node_id=1)
+    j.emit("ok", {"i": 1})
+    j.flush()
+    j.close()
+    seg = max(root.glob("events-*.jsonl"))
+    # simulate a crash mid-append: a trailing record with no newline
+    with open(seg, "ab") as f:
+        f.write(b'{"ts": 1.0, "type": "torn", "node"')
+    events, torn = read_events(root)
+    assert torn == 1
+    assert [e["type"] for e in events] == ["ok"]
+    # corrupt line in the MIDDLE is skipped too, records after it kept
+    with open(seg, "ab") as f:
+        f.write(b': 1}\n{"ts": 2.0, "type": "after", "node": 1}\n')
+    events, torn = read_events(root)
+    assert [e["type"] for e in events][-1] == "after"
+
+
+def test_journal_same_second_restart_never_appends(tmp_path, monkeypatch):
+    """Two boots within the same wall-clock second share the boot
+    timestamp in segment names; the second life must claim a FRESH
+    segment (create-only open, seq bumped past the first life's names)
+    — reopening in append mode would glue its first record onto the
+    previous life's torn tail, destroying both."""
+    import time as _time
+
+    from dfs_tpu.obs.journal import Journal, read_events
+
+    monkeypatch.setattr(_time, "time", lambda: 1_700_000_000.25)
+    root = tmp_path / "j"
+    j1 = Journal(root, node_id=1)
+    j1.emit("life1", {})
+    j1.flush()
+    j1.close()
+    segs1 = sorted(root.glob("events-*.jsonl"))
+    assert len(segs1) == 1
+    # crash artifact: torn final record, no newline
+    with open(segs1[0], "ab") as f:
+        f.write(b'{"ts": 1.0, "type": "torn"')
+    before = segs1[0].read_bytes()
+
+    j2 = Journal(root, node_id=1)   # same patched second -> same boot ts
+    j2.emit("life2", {})
+    j2.flush()
+    j2.close()
+    segs2 = sorted(root.glob("events-*.jsonl"))
+    assert len(segs2) == 2, "second life must open a fresh segment"
+    assert segs1[0].read_bytes() == before, "old life's tail touched"
+    events, torn = read_events(root)
+    assert torn == 1
+    assert [e["type"] for e in events] == ["life1", "life2"]
+
+
+def test_journal_bounded_queue_drops_not_blocks(tmp_path):
+    from dfs_tpu.obs.journal import Journal
+
+    j = Journal(tmp_path / "j", node_id=1)
+    try:
+        # pause the writer by holding the queue hostage: fill beyond
+        # capacity faster than one drain cycle can clear — emit() must
+        # return instantly either way and count what it sheds
+        for i in range(Journal._QUEUE_MAX * 2):
+            j.emit("burst", {"i": i})
+        st = j.stats()
+        assert st["emitted"] + st["dropped"] == Journal._QUEUE_MAX * 2
+    finally:
+        j.close()
+
+
+def test_journal_kill9_mid_write_tail_readable(tmp_path):
+    """The crash-safety contract, tested with a REAL ``kill -9``: a
+    subprocess journals continuously (large records, so the kill lands
+    mid-append with high probability); after SIGKILL the parent reopens
+    the directory and the tail must parse — at most the torn final
+    record discarded, never an exception."""
+    import signal
+    import subprocess
+    import sys as _sys
+    import time as _time
+
+    from dfs_tpu.obs.journal import read_events
+
+    root = tmp_path / "j"
+    child = subprocess.Popen(
+        [_sys.executable, "-c", (
+            "import sys\n"
+            "sys.path.insert(0, %r)\n"
+            "from dfs_tpu.obs.journal import Journal\n"
+            "j = Journal(%r, node_id=9, total_bytes=1 << 30,\n"
+            "            segment_bytes=1 << 30)\n"
+            "i = 0\n"
+            "while True:\n"
+            "    j.emit('spam', {'i': i, 'pad': 'x' * 65536})\n"
+            "    i += 1\n") % (str(Path(__file__).parent.parent),
+                               str(root))])
+    try:
+        deadline = _time.monotonic() + 30
+        # wait until real bytes are on disk, then strike mid-stream
+        while _time.monotonic() < deadline:
+            segs = list(root.glob("events-*.jsonl")) if root.exists() \
+                else []
+            if segs and segs[0].stat().st_size > 4 * 65536:
+                break
+            _time.sleep(0.01)
+        else:
+            pytest.fail("journal subprocess never wrote")
+    finally:
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=30)
+
+    events, torn = read_events(root, limit=4096)   # must not raise
+    assert events, "no complete records survived the kill"
+    assert all(e["type"] == "spam" for e in events)
+    # monotone sequence numbers — the tail is the true write frontier
+    idx = [e["i"] for e in events]
+    assert idx == sorted(idx)
+    # reopening for a NEW life starts a fresh segment, torn tail stays
+    # quarantined in the old one
+    from dfs_tpu.obs.journal import Journal
+
+    j2 = Journal(root, node_id=9)
+    j2.emit("boot", {})
+    j2.flush()
+    j2.close()
+    events2, _ = read_events(root, limit=4096)
+    assert events2[-1]["type"] == "boot"
+
+
+def test_journal_writer_survives_disk_trouble(tmp_path):
+    """A rotation-time OSError (ENOSPC, vanished directory) must not
+    kill the writer thread: stats() would keep saying enabled while the
+    flight recorder was silently dead. The failure is counted
+    (``ioErrors``), the batch drops, and journaling RESUMES when the
+    disk recovers."""
+    import shutil
+
+    from dfs_tpu.obs.journal import Journal, read_events
+
+    root = tmp_path / "j"
+    j = Journal(root, node_id=1, total_bytes=1 << 20, segment_bytes=512)
+    # a segment bigger than the whole budget clamps to it: the active
+    # segment is never swept, so it alone must not overshoot the cap
+    assert Journal(tmp_path / "clamp", node_id=1, total_bytes=1024,
+                   segment_bytes=1 << 20).segment_bytes == 1024
+    try:
+        j.emit("boot", {})
+        j.flush()
+        # yank the directory out from under the writer and squat its
+        # name with a FILE: every segment reopen now fails with an
+        # OSError that is not FileExistsError
+        shutil.rmtree(root)
+        root.write_text("not a directory")
+        # big records force a rotation attempt (segment_bytes=512)
+        for i in range(32):
+            j.emit("spam", {"i": i, "pad": "x" * 256})
+        j.flush()
+        assert j._writer.is_alive(), "writer thread died on disk trouble"
+        st = j.stats()
+        assert st["enabled"] and st["ioErrors"] > 0
+        # the read side answers empty while the dir is sick — /events
+        # must work exactly when the disk is the thing going wrong
+        assert read_events(root) == ([], 0)
+        # disk recovers: the next batch reopens a fresh segment
+        root.unlink()
+        root.mkdir()
+        j.emit("recovered", {})
+        j.flush()
+        events, _ = read_events(root)
+        assert any(e["type"] == "recovered" for e in events)
+    finally:
+        j.close()
+
+
+# --------------------------------------------------------------------- #
+# sentinel (obs/sentinel.py)
+# --------------------------------------------------------------------- #
+
+def test_sentinel_lag_incident_journaled(tmp_path):
+    from dfs_tpu.obs.journal import Journal, read_events
+    from dfs_tpu.obs.sentinel import Sentinel
+
+    journal = Journal(tmp_path / "j", node_id=1)
+    obs = Observability(ObsConfig(trace_ring=8), node_id=1,
+                        journal=journal)
+    sent = Sentinel(obs, interval_s=0.01, lag_s=0.005)
+
+    async def run():
+        # drive _sample_once directly with synthetic lags: the loop
+        # body is what matters, not wall-clock sleeps
+        await sent._sample_once(0.0)       # under threshold: no incident
+        await sent._sample_once(0.05)      # over: loop_lag incident
+
+    asyncio.run(run())
+    st = sent.stats()
+    assert st["samples"] == 2 and st["incidents"] == 1
+    assert st["maxLagS"] == pytest.approx(0.05)
+    journal.flush()
+    journal.close()
+    events, _ = read_events(tmp_path / "j")
+    assert [e["type"] for e in events] == ["loop_lag"]
+    assert events[0]["lagS"] == pytest.approx(0.05)
+
+
+def test_sentinel_recent_max_lag_window_expires():
+    """``recentMaxLagS`` is the windowed gauge the doctor's loop_lag
+    rule reads: a spike must age out of it (while the lifetime
+    ``maxLagS`` keeps it) so one historical stall cannot latch the
+    diagnosis red forever."""
+    import time as _time
+
+    from dfs_tpu.obs.sentinel import Sentinel
+
+    obs = Observability(ObsConfig(trace_ring=8), node_id=1)
+    sent = Sentinel(obs, interval_s=0.01, lag_s=0.25)
+    sent.RECENT_WINDOW_S = 0.05   # shrink the window for the test
+
+    async def run():
+        await sent._sample_once(0.5)           # the historical spike
+        assert sent.stats()["recentMaxLagS"] == pytest.approx(0.5)
+        _time.sleep(0.1)                       # let it age out
+        await sent._sample_once(0.0)
+
+    asyncio.run(run())
+    st = sent.stats()
+    assert st["maxLagS"] == pytest.approx(0.5)      # lifetime keeps it
+    assert st["recentMaxLagS"] == pytest.approx(0.0)  # window forgot it
+
+
+def test_sentinel_cas_backlog_and_credit_stall(tmp_path):
+    from dfs_tpu.obs.journal import Journal, read_events
+    from dfs_tpu.obs.sentinel import Sentinel
+    from dfs_tpu.utils.logging import Stopwatches
+
+    class FakeCas:
+        pending = 999
+        _workers = 2
+
+    journal = Journal(tmp_path / "j", node_id=1)
+    obs = Observability(ObsConfig(trace_ring=8), node_id=1,
+                        journal=journal)
+    stalls = Stopwatches()
+    sent = Sentinel(obs, cas=FakeCas(), stalls=stalls,
+                    interval_s=1.0, lag_s=0.25)
+
+    async def run():
+        await sent._sample_once(0.0)       # primes the credit baseline
+        stalls.add("creditS", 0.9)         # 0.9s stalled within 1s tick
+        await sent._sample_once(0.0)
+        # duty cycle is judged over the ACTUAL sample period: 0.9s of
+        # stall across a lag-stretched ~2s period is 45% — under the
+        # 50% fraction, so no incident (judging it against the nominal
+        # 1s interval would blame placement for the loop's own stall)
+        stalls.add("creditS", 0.9)
+        await sent._sample_once(1.0)
+
+    asyncio.run(run())
+    journal.flush()
+    journal.close()
+    events, _ = read_events(tmp_path / "j")
+    types = [e["type"] for e in events]
+    assert types.count("cas_backlog") == 3     # saturated every sample
+    assert types.count("credit_stall") == 1    # only after the in-budget
+    # delta; the lag-stretched third sample journals loop_lag instead
+    assert types.count("loop_lag") == 1
+    st = sent.stats()
+    assert st["casPending"] == 999
+    assert st["creditStallS"] == pytest.approx(0.9)
+
+
+# --------------------------------------------------------------------- #
+# doctor rule table (obs/doctor.py)
+# --------------------------------------------------------------------- #
+
+def _snap(nid, **over):
+    base = {"nodeId": nid, "now": 1000.0, "configHash": "cafe" * 16,
+            "chunks": 10, "files": 1, "peersAlive": {},
+            "admission": {}, "cache": {"enabled": False},
+            "ingestStalls": {}, "sentinel": {"enabled": False},
+            "rpcClient": {}, "incidents": [], "disk": {}}
+    base.update(over)
+    return base
+
+
+def _findings(snaps, now=1000.0):
+    from dfs_tpu.obs.doctor import diagnose
+
+    return {f["rule"]: f for f in diagnose(snaps, coordinator_now=now)}
+
+
+def test_doctor_healthy_cluster_is_clean():
+    assert _findings({1: _snap(1), 2: _snap(2), 3: _snap(3)}) == {}
+
+
+def test_doctor_dead_peer_from_probe_and_registry():
+    got = _findings({1: _snap(1, peersAlive={"3": False, "2": True}),
+                     2: _snap(2), 3: None})
+    f = got["dead_peer"]
+    assert f["peers"] == [3] and f["severity"] == "critical"
+    assert "no answer" in f["evidence"] and "reported dead" in f["evidence"]
+
+
+def test_doctor_slow_peer_names_the_right_node():
+    def rpc(ms_by_peer, calls=100):
+        return {f"{p}:get_chunks": {"count": calls, "errors": 0,
+                                    "retries": 0,
+                                    "seconds": ms * calls / 1000.0}
+                for p, ms in ms_by_peer.items()}
+
+    # node 3 answers 10x slower than the others, seen from two nodes
+    got = _findings({
+        1: _snap(1, rpcClient=rpc({2: 8, 3: 120})),
+        2: _snap(2, rpcClient=rpc({1: 9, 3: 110})),
+        3: _snap(3, rpcClient=rpc({1: 8, 2: 9}))})
+    f = got["slow_peer"]
+    assert f["peers"] == [3]
+    assert "ms" in f["evidence"]
+    # a uniformly-loaded cluster is NOT all "slow" (relative rule)
+    got = _findings({
+        1: _snap(1, rpcClient=rpc({2: 100, 3: 100})),
+        2: _snap(2, rpcClient=rpc({1: 100, 3: 100}))})
+    assert "slow_peer" not in got
+    # absolute floor: 3x spread under 50ms mean is noise, not pathology
+    got = _findings({
+        1: _snap(1, rpcClient=rpc({2: 1, 3: 30})),
+        2: _snap(2, rpcClient=rpc({1: 1, 3: 30}))})
+    assert "slow_peer" not in got
+
+
+def test_doctor_slow_peer_unlatches_after_recovery():
+    """A peer that spent an hour dead has a lifetime mean full of
+    ~75ms connect-timeout 'calls'; the rule must read the WINDOWED
+    means (recentSeconds/recentCount) so the recovered peer stops
+    being diagnosed slow once fast calls fill the window (found live
+    in r11 verify: doctor stayed red after a node restart)."""
+    def rpc(life_ms, recent_ms, calls=600, recent_calls=50):
+        return {f"{p}:get_chunks": {
+                    "count": calls, "errors": 0, "retries": 0,
+                    "seconds": ms * calls / 1000.0,
+                    "recentSeconds": recent_ms[p] * recent_calls / 1000.0,
+                    "recentCount": recent_calls}
+                for p, ms in life_ms.items()}
+
+    # lifetime table says 3 is slow (75ms vs 4ms); the window says fine
+    got = _findings({
+        1: _snap(1, rpcClient=rpc({2: 4, 3: 75}, {2: 4, 3: 5})),
+        2: _snap(2, rpcClient=rpc({1: 4, 3: 78}, {1: 4, 3: 6}))})
+    assert "slow_peer" not in got
+    # a CURRENTLY slow peer still fires on the windowed means
+    got = _findings({
+        1: _snap(1, rpcClient=rpc({2: 4, 3: 5}, {2: 4, 3: 120})),
+        2: _snap(2, rpcClient=rpc({1: 4, 3: 6}, {1: 4, 3: 110}))})
+    assert got["slow_peer"]["peers"] == [3]
+
+
+def test_rpc_stats_recent_window():
+    """snapshot() carries windowed recentSeconds/recentCount next to
+    the lifetime counters, and the window forgets old calls."""
+    st = RpcStats()
+    st.RECENT_WINDOW_S = 0.05
+    st.record(3, "get_chunks", 0.075)
+    row = st.snapshot()["3:get_chunks"]
+    assert row["recentCount"] == 1
+    assert row["recentSeconds"] == pytest.approx(0.075)
+    import time as _time
+
+    _time.sleep(0.1)
+    st.record(3, "get_chunks", 0.004)
+    row = st.snapshot()["3:get_chunks"]
+    # lifetime remembers both calls; the window only the fresh one
+    assert row["count"] == 2
+    assert row["seconds"] == pytest.approx(0.079)
+    assert row["recentCount"] == 1
+    assert row["recentSeconds"] == pytest.approx(0.004)
+
+
+def test_doctor_shed_storm_credit_and_clock_rules():
+    got = _findings({
+        1: _snap(1, admission={"download": {"shed": 40}}),
+        2: _snap(2, ingestStalls={"creditS": 5.0}),
+        3: _snap(3, now=1007.5)})
+    assert got["shed_storm"]["peers"] == [1]
+    assert "40" in got["shed_storm"]["evidence"]
+    assert got["credit_starvation"]["peers"] == [2]
+    assert got["clock_skew"]["peers"] == [3]
+    assert "+7.5s" in got["clock_skew"]["evidence"]
+
+
+def test_doctor_config_drift_and_loop_lag():
+    got = _findings({
+        1: _snap(1), 2: _snap(2, configHash="beef" * 16),
+        3: _snap(3, sentinel={"enabled": True, "maxLagS": 2.0,
+                              "lagThresholdS": 0.25, "incidents": 7})})
+    assert sorted(got["config_drift"]["peers"]) == [1, 2, 3]
+    assert got["loop_lag"]["peers"] == [3]
+    assert "2.000s" in got["loop_lag"]["evidence"]
+
+
+def test_doctor_shed_storm_and_loop_lag_do_not_latch():
+    """One historical incident must not gate the cluster red for the
+    rest of the process lifetime: shed_storm and loop_lag read the
+    WINDOWED gauges (``shedRecent`` / ``recentMaxLagS``) and fall back
+    to the lifetime counters only for old-build peers that lack them."""
+    # recovered cluster: lifetime counters remember, windows are cold
+    got = _findings({
+        1: _snap(1, admission={"download": {"shed": 40,
+                                            "shedRecent": 0}}),
+        2: _snap(2, sentinel={"enabled": True, "maxLagS": 2.0,
+                              "recentMaxLagS": 0.0,
+                              "lagThresholdS": 0.25, "incidents": 7})})
+    assert "shed_storm" not in got and "loop_lag" not in got
+    # hot windows fire, evidence carries the WINDOWED magnitudes
+    got = _findings({
+        1: _snap(1, admission={"download": {"shed": 40,
+                                            "shedRecent": 3}}),
+        2: _snap(2, sentinel={"enabled": True, "maxLagS": 2.0,
+                              "recentMaxLagS": 0.5,
+                              "lagThresholdS": 0.25, "incidents": 7})})
+    assert got["shed_storm"]["peers"] == [1]
+    assert "3 requests shed" in got["shed_storm"]["evidence"]
+    assert got["loop_lag"]["peers"] == [2]
+    assert "0.500s" in got["loop_lag"]["evidence"]
+
+
+def test_doctor_cache_thrash_needs_real_traffic():
+    thrash = {"enabled": True, "hits": 100, "misses": 2000,
+              "inserts": 2000, "evictions": 1900}
+    got = _findings({1: _snap(1, cache=thrash), 2: _snap(2)})
+    assert got["cache_thrash"]["peers"] == [1]
+    quiet = dict(thrash, hits=5, misses=10, inserts=10, evictions=9)
+    assert "cache_thrash" not in _findings({1: _snap(1, cache=quiet),
+                                            2: _snap(2)})
+
+
+def test_doctor_malformed_snapshot_degrades_one_rule_not_the_report():
+    """Snapshot fields come over the wire from peers that may run a
+    different build — a malformed field must cost at most the rule it
+    confuses (visible as a doctor_error note), never 500 the report."""
+    got = _findings({
+        # garbage in the fields several rules read...
+        1: _snap(1, peersAlive={"not-a-node-id": False},
+                 rpcClient={"2:get_chunks": "not-a-row"},
+                 now="not-a-clock"),
+        # ...must not stop OTHER rules from diagnosing node 2's shed
+        2: _snap(2, admission={"download": {"shed": 9}}),
+        # a non-dict snapshot counts as no answer, not a crash
+        3: "garbage"})
+    assert got["shed_storm"]["peers"] == [2]
+    # dead_peer skips the malformed registry key and keeps its finding
+    assert got["dead_peer"]["peers"] == [3]
+    # the garbage clock crashed clock_skew — visibly, as an info note
+    assert "doctor_error" in got
+    assert got["doctor_error"]["severity"] == "info"
+    assert "crashed" in got["doctor_error"]["evidence"]
+
+
+def test_doctor_render_report_plaintext():
+    from dfs_tpu.obs.doctor import diagnose, render_report
+
+    snaps = {1: _snap(1), 2: None}
+    report = {"coordinator": 1, "now": 1000.0, "peersFailed": 1,
+              "nodes": {str(k): v for k, v in snaps.items()},
+              "findings": diagnose(snaps, coordinator_now=1000.0)}
+    out = render_report(report)
+    assert "node 2: NO ANSWER" in out
+    assert "[critical] dead_peer" in out
+    report["findings"] = []
+    assert "no pathology detected" in render_report(report)
 
 
 # --------------------------------------------------------------------- #
@@ -387,6 +1142,177 @@ def test_trace_endpoint_validates_id(tmp_path):
 
 
 # --------------------------------------------------------------------- #
+# cluster: /events + /doctor (the diagnosis plane end to end)
+# --------------------------------------------------------------------- #
+
+def test_events_endpoint_serves_journal(tmp_path):
+    async def run():
+        cluster = make_cluster_cfg(1, rf=1)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            node = nodes[1]
+            node.obs.event("peer_down", peer=9)
+            node.obs.journal.flush()
+            port = cluster.peers[0].port
+            out = json.loads((await asyncio.to_thread(
+                _req, port, "GET", "/events")).decode())
+            assert out["enabled"] is True
+            types = [e["type"] for e in out["events"]]
+            # the boot record is first; our event follows
+            assert types[0] == "boot" and "peer_down" in types
+            boot = out["events"][0]
+            assert boot["configHash"] == node._config_hash
+            # validation: bad since/limit are 400s, not 500s
+            for q in ("?since=nope", "?limit=0", "?limit=99999"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    await asyncio.to_thread(_req, port, "GET",
+                                            f"/events{q}")
+                assert ei.value.code == 400
+                ei.value.read()
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_events_endpoint_journal_disabled(tmp_path):
+    async def run():
+        cluster = make_cluster_cfg(1, rf=1)
+        nodes = await start_nodes(cluster, tmp_path,
+                                  obs=ObsConfig(journal_bytes=0))
+        try:
+            out = json.loads((await asyncio.to_thread(
+                _req, cluster.peers[0].port, "GET", "/events")).decode())
+            assert out == {"enabled": False, "events": []}
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_doctor_cluster_healthy_then_dead_peer(tmp_path, rng):
+    """3-node /doctor: healthy cluster produces a full per-node report
+    with no findings; killing a node turns exactly it into a dead_peer
+    finding (partial result, never an error)."""
+    data = rng.integers(0, 256, size=30_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            await nodes[1].upload(data, "d.bin")
+            port = cluster.peers[0].port
+            rep = json.loads((await asyncio.to_thread(
+                _req, port, "GET", "/doctor")).decode())
+            assert set(rep["nodes"]) == {"1", "2", "3"}
+            assert rep["peersFailed"] == 0
+            assert rep["findings"] == []
+            snap = rep["nodes"]["2"]
+            assert snap["chunks"] > 0 and snap["configHash"]
+            assert snap["journal"]["enabled"] is True
+            # same policy config everywhere: one fingerprint
+            assert len({s["configHash"]
+                        for s in rep["nodes"].values()}) == 1
+
+            await nodes[3].stop()
+            rep2 = json.loads((await asyncio.to_thread(
+                _req, port, "GET", "/doctor")).decode())
+            assert rep2["peersFailed"] == 1
+            dead = [f for f in rep2["findings"]
+                    if f["rule"] == "dead_peer"]
+            assert dead and dead[0]["peers"] == [3]
+            # local-only mode still answers, without the fan-out
+            rep3 = json.loads((await asyncio.to_thread(
+                _req, port, "GET", "/doctor?cluster=0")).decode())
+            assert set(rep3["nodes"]) == {"1"}
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_doctor_names_injected_slow_peer(tmp_path, rng):
+    """The OBS2_r11.json acceptance scenario in miniature: delay node
+    3's dispatch, drive traffic, and the doctor must name node 3 —
+    and only node 3 — as slow_peer."""
+    data = rng.integers(0, 256, size=30_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = make_cluster_cfg(3, rf=3)
+        nodes = await start_nodes(cluster, tmp_path)
+        try:
+            real_dispatch = nodes[3]._dispatch
+
+            # the lag must DOMINATE the real per-call work, which on a
+            # cold loaded host (first JIT, slow disk) has been observed
+            # at 150ms+ per call — 1s keeps node 3's mean past the 3x
+            # rule threshold with margin even then
+            async def laggy(header, body):
+                await asyncio.sleep(1.0)
+                return await real_dispatch(header, body)
+
+            nodes[3]._dispatch = laggy
+            for i in range(2):
+                await nodes[1].upload(data + bytes([i]), f"s{i}.bin")
+            rep = json.loads((await asyncio.to_thread(
+                _req, cluster.peers[1].port, "GET", "/doctor")).decode())
+            slow = [f for f in rep["findings"]
+                    if f["rule"] == "slow_peer"]
+            assert slow, f"no slow_peer finding: {rep['findings']}"
+            assert slow[0]["peers"] == [3]
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_shed_events_reach_the_journal(tmp_path):
+    from dfs_tpu.config import ServeConfig
+    from dfs_tpu.serve.admission import ShedError
+
+    async def run():
+        cluster = make_cluster_cfg(1, rf=1)
+        nodes = await start_nodes(
+            cluster, tmp_path,
+            serve=ServeConfig(download_slots=1, queue_depth=0))
+        try:
+            node = nodes[1]
+            gate = node.serve.admission.download
+            await gate.acquire()            # slot taken, queue depth 0
+            with pytest.raises(ShedError):
+                await gate.acquire()        # -> shed + journal event
+            gate.release()
+            node.obs.journal.flush()
+            out = await asyncio.to_thread(node.obs.journal.tail, 0.0, 64)
+            shed = [e for e in out["events"] if e["type"] == "shed"]
+            assert shed and shed[0]["cls"] == "download"
+        finally:
+            await stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_serve_cli_exposes_obs_diagnosis_flags():
+    """DFS005 satellite: every new ObsConfig field must be reachable
+    from the CLI and land in the right config slot."""
+    from dfs_tpu.cli.main import build_parser
+
+    ns = build_parser().parse_args(
+        ["serve", "--node-id", "1", "--tail-keep", "64",
+         "--journal-bytes", "1048576", "--journal-segment-bytes",
+         "65536", "--sentinel-interval", "0.5", "--sentinel-lag", "0.1"])
+    assert (ns.tail_keep, ns.journal_bytes) == (64, 1048576)
+    assert (ns.journal_segment_bytes, ns.sentinel_interval,
+            ns.sentinel_lag) == (65536, 0.5, 0.1)
+    # events/doctor subcommands parse
+    ns = build_parser().parse_args(["events", "--since", "12.5",
+                                    "--limit", "32"])
+    assert (ns.since, ns.limit) == (12.5, 32)
+    ns = build_parser().parse_args(["doctor", "--local", "--json"])
+    assert ns.local and ns.json
+
+
+# --------------------------------------------------------------------- #
 # Prometheus exposition + JSON backward compatibility
 # --------------------------------------------------------------------- #
 
@@ -420,12 +1346,14 @@ def test_prom_exposition_and_json_superset(tmp_path, rng):
             await stop_nodes(nodes)
 
     prom, prom2, js = asyncio.run(run())
-    samples, types = parse_prom(prom)
-    samples2, _ = parse_prom(prom2)
+    samples, types, exemplars = parse_prom(prom)
+    samples2, _, _ = parse_prom(prom2)
 
     # counters made it over
     assert samples[("dfs_counter_total", (("name", "uploads"),))] == 1.0
-    assert types["dfs_counter_total"] == "counter"
+    # OpenMetrics: TYPE names the family, samples carry _total
+    assert types["dfs_counter"] == "counter"
+    assert "dfs_counter_total" not in types
 
     # RPC per-peer per-op client series exist for real peers
     rpc_ops = {lbls for (name, lbls) in samples
@@ -454,10 +1382,50 @@ def test_prom_exposition_and_json_superset(tmp_path, rng):
         assert buckets[-1][0] == float("inf")
         assert buckets[-1][1] == count
 
+    # OpenMetrics exemplars: the always-on traced requests tagged their
+    # per-route latency buckets with their trace ids (r11 exemplars)
+    ex_names = {dict(lbls).get("name")
+                for (name, lbls) in exemplars
+                if name == "dfs_latency_seconds_bucket"}
+    assert {"http./download", "http./upload"} <= ex_names
+
     # default JSON output: strict superset of the r08 schema
     assert R08_METRICS_KEYS <= set(js)
     assert "obs" in js and js["obs"]["traceRing"] == 2048
     assert "rpcClient" in js["obs"]
+    # r11 diagnosis-plane keys ride the obs section (DFS005 mirrors)
+    assert js["obs"]["tailKeep"] == 256
+    assert js["obs"]["journal"]["enabled"] is True
+    assert js["obs"]["sentinel"]["enabled"] is True
+
+
+# --------------------------------------------------------------------- #
+# tier-1 smoke: bench_obs --tiny exercises all three OBS2_r11.json
+# phases (overhead arms, injected slow peer, tail-keep + exemplar) and
+# its gates must hold at tiny scale too
+# --------------------------------------------------------------------- #
+
+def test_bench_obs_tiny(tmp_path):
+    import subprocess
+    import sys as _sys
+
+    REPO = Path(__file__).resolve().parent.parent
+    out_path = tmp_path / "OBS2_tiny.json"
+    r = subprocess.run(
+        [_sys.executable, str(REPO / "bench_obs.py"),
+         "--tiny", "--out", str(out_path)],
+        capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(out_path.read_text())
+    assert out["ok"] is True
+    assert out["doctor"]["named_correctly"] is True
+    assert out["tailkeep"]["retained"] is True
+    assert out["tailkeep"]["exemplar_on_download_histogram"] is True
+    assert out["tailkeep"]["ordinary_trace_evicted"] is True
+    # schema must match the committed artifact's (stale-schema guard)
+    committed = json.loads((REPO / "OBS2_r11.json").read_text())
+    assert set(committed) == set(out)
+    assert set(committed["tailkeep"]) == set(out["tailkeep"])
 
 
 # --------------------------------------------------------------------- #

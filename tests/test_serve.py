@@ -190,6 +190,9 @@ def test_admission_gate_sheds_beyond_queue_depth():
             await g.acquire()                 # queue full -> shed
         assert ei.value.retry_after_s == 2.0
         assert g.stats()["shed"] == 1
+        # the windowed gauge the doctor's shed_storm rule reads: fresh
+        # sheds are in-window (it decays to 0 after SHED_WINDOW_S)
+        assert g.stats()["shedRecent"] == 1
         g.release()                           # slot transfers to waiter
         await waiter
         assert g.stats()["active"] == 2

@@ -241,7 +241,7 @@ def make_http_handler(node: "StorageNodeServer"):
 _TRACED_ROUTES = frozenset({
     "/status", "/files", "/metrics", "/manifest", "/chunking", "/missing",
     "/upload_resume", "/upload", "/download", "/scrub", "/repair",
-    "/trace", "/events", "/doctor"})
+    "/trace", "/events", "/doctor", "/census", "/metrics/history"})
 
 
 async def _serve_one(node: "StorageNodeServer",
@@ -347,7 +347,35 @@ async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
         # window/credit bounds, stall attribution, CAS-tier queue/busy
         snap["obs"] = node.obs.stats()   # trace ring + RPC tables —
         # ADDITIVE: the pre-r09 JSON schema stays a strict subset
+        snap["census"] = node.census_stats()  # capacity gauges +
+        # history-sampler config/state (r12, additive like "obs")
         return as_json(200, snap)
+
+    if method == "GET" and path == "/metrics/history":
+        # embedded metrics history (docs/observability.md): downsampled
+        # multi-resolution series the census sampler maintains. No name
+        # -> the series directory; sampler off -> enabled:false, never
+        # an error (the /events discipline).
+        history = node.history
+        if history is None:
+            return as_json(200, {"enabled": False, "series": []})
+        name = query.get("name")
+        if not name:
+            return as_json(200, {"enabled": True,
+                                 "series": history.names()})
+        snap = history.snapshot(name)
+        if snap is None:
+            return plain(404, "Unknown series")
+        snap["enabled"] = True
+        return as_json(200, snap)
+
+    if method == "GET" and path == "/census":
+        # replication-health census + cluster capacity (df): fan out
+        # bucketed inventories (partial on dead peers), cross-reference
+        # manifests, answer with the replication histogram + bounded
+        # finding lists. &cluster=0 = this node's inventory only.
+        return as_json(200, await node.census_report(
+            cluster=query.get("cluster", "1") != "0"))
 
     if method == "GET" and path == "/trace":
         from dfs_tpu.obs import TRACE_HEX, is_id

@@ -177,6 +177,20 @@ class NodeClient:
         q = urllib.parse.urlencode({"cluster": "1" if cluster else "0"})
         return json.loads(self._request("GET", f"/doctor?{q}"))
 
+    def census(self, cluster: bool = True) -> dict:
+        """Replication-health census + capacity report (GET /census) —
+        render with dfs_tpu.obs.census.render_census / render_df."""
+        q = urllib.parse.urlencode({"cluster": "1" if cluster else "0"})
+        return json.loads(self._request("GET", f"/census?{q}"))
+
+    def history(self, name: str | None = None) -> dict:
+        """Embedded metrics history (GET /metrics/history): the series
+        directory, or one series' multi-resolution points."""
+        path = "/metrics/history"
+        if name:
+            path += "?" + urllib.parse.urlencode({"name": name})
+        return json.loads(self._request("GET", path))
+
     def trace(self, trace_id: str, cluster: bool = True) -> dict:
         """Spans of one trace, stitched cluster-wide by the contacted
         node (GET /trace) — render with dfs_tpu.obs.stitch.render_tree."""

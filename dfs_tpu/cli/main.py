@@ -6,7 +6,7 @@ Interactive menu reproduces Client.java:36-40 exactly:
     0 Exit | 1 Test server | 2 List files | 3 Upload file | 4 Download file
 
 Scriptable subcommands: serve, sidecar, status, list, upload, download,
-delete, metrics, trace, events, doctor, menu.
+delete, metrics, trace, events, doctor, census, df, menu.
 """
 
 from __future__ import annotations
@@ -17,9 +17,9 @@ import sys
 from pathlib import Path
 
 from dfs_tpu.cli.client import NodeClient
-from dfs_tpu.config import (CDCParams, ClusterConfig, FragmenterConfig,
-                            IngestConfig, NodeConfig, ObsConfig,
-                            ServeConfig)
+from dfs_tpu.config import (CDCParams, CensusConfig, ClusterConfig,
+                            FragmenterConfig, IngestConfig, NodeConfig,
+                            ObsConfig, ServeConfig)
 
 
 def _client(args) -> NodeClient:
@@ -73,7 +73,13 @@ def cmd_serve(args) -> int:
                       journal_bytes=args.journal_bytes,
                       journal_segment_bytes=args.journal_segment_bytes,
                       sentinel_interval_s=args.sentinel_interval,
-                      sentinel_lag_s=args.sentinel_lag))
+                      sentinel_lag_s=args.sentinel_lag),
+        census=CensusConfig(
+            history_interval_s=args.census_interval,
+            history_slots=args.census_history_slots,
+            history_coarse_every=args.census_coarse_every,
+            history_coarse_slots=args.census_coarse_slots,
+            max_listed=args.census_max_listed))
 
     async def run() -> None:
         from dfs_tpu.utils.aio import create_logged_task
@@ -286,6 +292,38 @@ def cmd_doctor(args) -> int:
     return 1 if sick else 0
 
 
+def cmd_census(args) -> int:
+    """Replication-health census (GET /census): histogram + bounded
+    under-replicated / orphaned / over-replicated lists. Scriptable as
+    a data-health gate: exit 1 on findings or unreachable peers."""
+    from dfs_tpu.obs.census import render_census
+
+    report = _client(args).census(cluster=not args.local)
+    print(render_census(report))
+    if args.json:
+        import json
+
+        print(json.dumps(report, indent=2, sort_keys=True))
+    sick = any(report.get(f"{k}Total") for k in
+               ("underReplicated", "orphaned", "overReplicated")) \
+        or report.get("peersFailed", 0)
+    return 1 if sick else 0
+
+
+def cmd_df(args) -> int:
+    """Cluster capacity (the storage-native df(1)): per-node and
+    cluster CAS bytes, disk headroom, dedup ratio — the capacity
+    section of GET /census."""
+    from dfs_tpu.obs.census import render_df
+
+    report = _client(args).census(cluster=True)
+    print(render_df(report))
+    if report.get("peersFailed"):
+        print(f"(warning: {report['peersFailed']} peer(s) unreachable "
+              "— totals are partial)", file=sys.stderr)
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Stitch + render one distributed trace (docs/observability.md):
     the contacted node gathers every peer's spans for the id and this
@@ -475,6 +513,23 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--sentinel-lag", type=float, default=0.25,
                        help="event-loop lag (s) above which the "
                             "sentinel journals a loop_lag incident")
+    serve.add_argument("--census-interval", type=float, default=10.0,
+                       help="metrics-history sample period (s) for the "
+                            "census/capacity plane; 0 disables the "
+                            "sampler (census queries still work)")
+    serve.add_argument("--census-history-slots", type=int, default=360,
+                       help="fine-resolution history buckets kept per "
+                            "series")
+    serve.add_argument("--census-coarse-every", type=int, default=30,
+                       help="fine steps folded into one coarse history "
+                            "bucket")
+    serve.add_argument("--census-coarse-slots", type=int, default=288,
+                       help="coarse-resolution history buckets kept "
+                            "per series")
+    serve.add_argument("--census-max-listed", type=int, default=64,
+                       help="digests listed per census finding "
+                            "category (under-replicated / orphaned / "
+                            "over-replicated)")
     serve.set_defaults(fn=cmd_serve)
 
     sc = sub.add_parser("sidecar", help="run the chunk+hash sidecar service")
@@ -533,6 +588,20 @@ def build_parser() -> argparse.ArgumentParser:
     dr.add_argument("--json", action="store_true",
                     help="also print the full report as JSON")
     dr.set_defaults(fn=cmd_doctor)
+    cn = sub.add_parser("census",
+                        help="replication-health census (digest "
+                             "copies histogram + under-replicated/"
+                             "orphaned/over-replicated findings)")
+    cn.add_argument("--local", action="store_true",
+                    help="inventory the contacted node only (no peer "
+                         "fan-out)")
+    cn.add_argument("--json", action="store_true",
+                    help="also print the full report as JSON")
+    cn.set_defaults(fn=cmd_census)
+    df = sub.add_parser("df",
+                        help="cluster capacity: per-node CAS bytes, "
+                             "disk headroom, dedup ratio")
+    df.set_defaults(fn=cmd_df)
     tr = sub.add_parser("trace",
                         help="render a stitched cross-node trace")
     tr.add_argument("trace_id")

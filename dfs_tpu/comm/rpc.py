@@ -375,6 +375,22 @@ class InternalClient:
             retries=retries, timeout_s=self._bulk_timeout(expect_bytes))
         return unpack_chunks(resp.get("chunks", []), body)
 
+    async def get_census(self, peer: PeerAddr,
+                         prefixes: list[str] | None = None,
+                         retries: int | None = None) -> dict | None:
+        """Census inventory of one peer (docs/observability.md): the
+        bucketed CAS summary, or — with ``prefixes`` — member digest
+        lists for exactly those buckets (the census drill-down; the
+        receiver caps each list). Callers pass ``retries=1``: the
+        census is partial-on-dead by contract, so a dead peer must cost
+        one fast probe, not the full retry envelope."""
+        header: dict = {"op": "get_census"}
+        if prefixes:
+            header["prefixes"] = list(prefixes)
+        resp, _ = await self.call(peer, header, retries=retries)
+        census = resp.get("census")
+        return census if isinstance(census, dict) else None
+
     async def get_manifest(self, peer: PeerAddr, file_id: str
                            ) -> tuple[str | None, float | None]:
         """-> (manifest json or None, origin mtime or None). The mtime is

@@ -251,6 +251,44 @@ class ObsConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class CensusConfig:
+    """Cluster census & capacity plane (dfs_tpu.obs.census /
+    obs.history — docs/observability.md).
+
+    The census itself is pull-driven (``GET /census`` fans out an
+    internal ``get_census`` op and costs nothing until asked); the only
+    steady-state cost these knobs control is the embedded metrics
+    history sampler — a fixed-memory, multi-resolution ring of selected
+    counters/gauges (ingest/serve/RPC/CAS/capacity) that feeds
+    ``GET /metrics/history`` and the doctor's trend rules
+    (``capacity_trend`` disk-full ETA). Defaults keep ~1 h at 10 s and
+    ~24 h at 5 min per series; ``history_interval_s=0`` turns sampling
+    fully off (census queries still work, trend rules go quiet).
+    """
+
+    history_interval_s: float = 10.0  # fine-resolution sample period
+                                # (s); 0 = the history sampler is off
+    history_slots: int = 360    # fine buckets kept per series (1 h at
+                                # the default 10 s step)
+    history_coarse_every: int = 30   # fine steps per coarse bucket
+                                # (5 min at the defaults)
+    history_coarse_slots: int = 288  # coarse buckets kept (24 h)
+    max_listed: int = 64        # bounded per-category digest lists in
+                                # census findings (under-replicated /
+                                # orphaned / over-replicated)
+
+    def __post_init__(self) -> None:
+        if self.history_interval_s < 0:
+            raise ValueError("history_interval_s must be >= 0")
+        if self.history_slots < 1 or self.history_coarse_slots < 1:
+            raise ValueError("history slots must be >= 1")
+        if self.history_coarse_every < 1:
+            raise ValueError("history_coarse_every must be >= 1")
+        if self.max_listed < 1:
+            raise ValueError("max_listed must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
 class IngestConfig:
     """Pipelined write path (docs/ingest.md) — the knobs bounding how much
     of the three-stage ingest pipeline (fragmentation, local CAS writes,
@@ -330,6 +368,10 @@ class NodeConfig:
     # observability: span ring + slow threshold; ObsConfig(trace_ring=0)
     # turns tracing fully off (metrics remain)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
+    # cluster census & capacity plane: metrics-history sampler bounds +
+    # census finding-list caps; CensusConfig(history_interval_s=0)
+    # disables the sampler (census queries stay available)
+    census: CensusConfig = dataclasses.field(default_factory=CensusConfig)
 
     @property
     def self_addr(self) -> PeerAddr:

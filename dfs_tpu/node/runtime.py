@@ -49,7 +49,7 @@ from dfs_tpu.store.aio import AsyncChunkStore
 from dfs_tpu.store.cas import NodeStore
 from dfs_tpu.utils.hashing import (is_hex_digest, sha256_hex,
                                    sha256_many_hex, sha256_new)
-from dfs_tpu.utils.aio import gather_abort_siblings
+from dfs_tpu.utils.aio import create_logged_task, gather_abort_siblings
 from dfs_tpu.utils.logging import Counters, Stopwatches, get_logger
 from dfs_tpu.utils.trace import LatencyRecorder
 
@@ -291,6 +291,22 @@ class StorageNodeServer:
         # admission gates + readahead. Default config = every component
         # off, and the node runs the historical code paths exactly.
         self.serve = ServingTier(cfg.serve, obs=self.obs)
+        # census/capacity plane (docs/observability.md): the embedded
+        # metrics-history ring a background sampler feeds — trend data
+        # for GET /metrics/history and the doctor's capacity_trend
+        # rule. None = sampling off (census queries still answer).
+        self.history = None
+        if cfg.census.history_interval_s > 0:
+            from dfs_tpu.obs.history import MetricsHistory
+
+            self.history = MetricsHistory(
+                cfg.census.history_interval_s, cfg.census.history_slots,
+                cfg.census.history_coarse_every,
+                cfg.census.history_coarse_slots)
+        self._history_task: asyncio.Task | None = None
+        # last coordinator census summary (doctor snapshot material)
+        self._last_census: dict | None = None
+        self._disk_pressure = False
         self.log = get_logger("node", cfg.node_id)
         self.under_replicated: set[str] = set()  # digests needing repair
         self._internal_server: asyncio.AbstractServer | None = None
@@ -321,6 +337,9 @@ class StorageNodeServer:
             self.health.start()
         if self.sentinel is not None:
             self.sentinel.start()
+        if self.history is not None:
+            self._history_task = create_logged_task(
+                self._history_loop(), self.log, "census-history")
         # flight-recorder boot record: the config this life ran with is
         # the first question of every post-mortem
         self.obs.event("boot", configHash=self._config_hash,
@@ -330,6 +349,9 @@ class StorageNodeServer:
                       self.cfg.node_id, addr.port, addr.internal_port)
 
     async def stop(self) -> None:
+        if self._history_task is not None:
+            self._history_task.cancel()
+            self._history_task = None
         if self.sentinel is not None:
             self.sentinel.stop()
         self.health.stop()
@@ -526,6 +548,22 @@ class StorageNodeServer:
             # must work exactly when the bulk gates are saturated; the
             # journal/disk reads inside run off-loop.
             return {"ok": True, "doctor": await self.doctor_snapshot()}, b""
+        if op == "get_census":
+            # bucketed CAS inventory for the cluster census fan-out
+            # (census_report below); optional `prefixes` drills member
+            # digest lists for mismatched buckets. Ungated like
+            # get_doctor — data-health diagnosis must answer while the
+            # bulk gates are saturated; the store scan runs on the
+            # bounded CAS read pool, never the loop.
+            prefixes = header.get("prefixes")
+            if prefixes is not None and not (
+                    isinstance(prefixes, list)
+                    and all(isinstance(p, str) and len(p) ==
+                            self.store.chunks.PREFIX_HEX
+                            for p in prefixes)):
+                return {"ok": False, "error": "bad prefixes"}, b""
+            return {"ok": True,
+                    "census": await self.census_inventory(prefixes)}, b""
         if op == "health":
             # counts must be O(1)/filename-only: every peer probes this
             # op every few seconds, and the full digests()+manifest-parse
@@ -2084,22 +2122,25 @@ class StorageNodeServer:
     # cluster doctor (docs/observability.md)
     # ------------------------------------------------------------------ #
 
+    def _disk_usage(self) -> dict:
+        """Blocking statvfs under the node's data root — call via
+        ``asyncio.to_thread`` (shared by the doctor snapshot, the
+        census inventory, and the history sampler)."""
+        import shutil
+
+        try:
+            u = shutil.disk_usage(self.store.root)
+            return {"totalBytes": u.total, "freeBytes": u.free}
+        # not silent: {} renders as unknown headroom in the report
+        except OSError:  # dfslint: ignore[DFS007]
+            return {}
+
     async def doctor_snapshot(self) -> dict:
         """This node's diagnosis snapshot: the per-node material the
         doctor rule table consumes — metric summaries, recent journal
         incidents, disk headroom, config fingerprint, wall clock. Every
         blocking read (journal tail, disk_usage, chunk count priming)
         runs off the event loop."""
-        import shutil
-
-        def disk() -> dict:
-            try:
-                u = shutil.disk_usage(self.store.root)
-                return {"totalBytes": u.total, "freeBytes": u.free}
-            # not silent: {} renders as unknown headroom in the report
-            except OSError:  # dfslint: ignore[DFS007]
-                return {}
-
         incidents: list[dict] = []
         if self.obs.journal is not None:
             tail = await asyncio.to_thread(self.obs.journal.tail, 0.0, 64)
@@ -2124,7 +2165,12 @@ class StorageNodeServer:
             "rpcClient": obs_stats["rpcClient"],
             "counters": self.counters.snapshot(),
             "incidents": incidents,
-            "disk": await asyncio.to_thread(disk),
+            "disk": await asyncio.to_thread(self._disk_usage),
+            # trend material for the doctor's capacity_trend rule
+            # (history-derived CAS growth slope) and the last census
+            # this node coordinated — feeds the underreplication rule
+            "capacity": self._capacity_summary(),
+            "census": self._last_census,
         }
 
     async def doctor_report(self, cluster: bool = True) -> dict:
@@ -2170,6 +2216,247 @@ class StorageNodeServer:
                 "peersFailed": failed,
                 "nodes": {str(k): v for k, v in sorted(snaps.items())},
                 "findings": findings}
+
+    # ------------------------------------------------------------------ #
+    # cluster census & capacity plane (docs/observability.md)
+    # ------------------------------------------------------------------ #
+
+    # per-bucket digest-list cap for census drill-downs: bounds one
+    # drill reply at DRILL_BUCKET_CAP x this many digests per node
+    _CENSUS_LIST_CAP = 4096
+    # disk_pressure journal event: fires crossing below 5% free, re-arms
+    # above 10% (hysteresis — a disk hovering at the line must not spam
+    # the flight recorder every sample)
+    _DISK_PRESSURE_FRACTION = 0.05
+    # counters the history sampler tracks (ingest/serve totals; rates
+    # fall out of differencing adjacent buckets)
+    _HISTORY_COUNTERS = ("http_requests", "uploads", "downloads",
+                         "upload_bytes", "download_bytes",
+                         "chunks_stored", "bytes_stored", "dedup_hits",
+                         "replication_failures", "http_shed")
+
+    async def _history_loop(self) -> None:
+        interval = self.cfg.census.history_interval_s
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self._history_sample_once()
+            except Exception as e:  # noqa: BLE001 — sampler must outlive
+                # one bad sample; the failure is logged, next tick retries
+                self.log.warning("census history sample failed: %s", e)
+
+    async def _history_sample_once(self) -> None:
+        """One history tick: selected counters/gauges into the
+        multi-resolution ring. Disk/CAS reads run off the loop; the
+        CAS byte gauge is O(1) after its one priming scan
+        (ChunkStore.bytes_total)."""
+        h = self.history
+        now = time.time()
+        c = self.counters.snapshot()
+        for k in self._HISTORY_COUNTERS:
+            h.observe(f"counter.{k}", c.get(k, 0), now)
+        h.observe("cas.pending", self.cas.pending, now)
+        h.observe("ingest.creditS",
+                  self.ingest_stalls.snapshot().get("creditS", 0.0), now)
+        cache = self.serve.cache
+        if cache is not None:
+            cs = cache.stats()
+            h.observe("cache.hits", cs["hits"], now)
+            h.observe("cache.misses", cs["misses"], now)
+            h.observe("cache.bytes", cs["bytes"], now)
+        calls = secs = 0
+        for _, _, row in self.obs.rpc_client.rows():
+            calls += row[0]
+            secs += row[5]
+        h.observe("rpc.clientCalls", calls, now)
+        h.observe("rpc.clientSeconds", secs, now)
+        h.observe("capacity.casBytes",
+                  await asyncio.to_thread(self.store.chunks.bytes_total),
+                  now)
+        h.observe("capacity.casChunks",
+                  await asyncio.to_thread(self.store.chunks.count), now)
+        disk = await asyncio.to_thread(self._disk_usage)
+        if disk:
+            h.observe("capacity.diskFreeBytes", disk["freeBytes"], now)
+            h.observe("capacity.diskTotalBytes", disk["totalBytes"], now)
+            frac = disk["freeBytes"] / max(1, disk["totalBytes"])
+            if frac < self._DISK_PRESSURE_FRACTION:
+                if not self._disk_pressure:
+                    self._disk_pressure = True
+                    self.obs.event("disk_pressure",
+                                   freeBytes=disk["freeBytes"],
+                                   totalBytes=disk["totalBytes"])
+            elif frac >= 2 * self._DISK_PRESSURE_FRACTION:
+                self._disk_pressure = False
+
+    def _capacity_summary(self) -> dict:
+        """History-derived capacity gauges + growth slope — the doctor
+        snapshot's trend material (capacity_trend rule). Reads only
+        the last sampled values: never a scan, safe on the loop."""
+        h = self.history
+        if h is None:
+            return {"enabled": False}
+        return {"enabled": True,
+                "casBytes": h.last("capacity.casBytes"),
+                "casChunks": h.last("capacity.casChunks"),
+                "diskFreeBytes": h.last("capacity.diskFreeBytes"),
+                "diskTotalBytes": h.last("capacity.diskTotalBytes"),
+                "growthBytesPerS": h.trend("capacity.casBytes")}
+
+    def census_stats(self) -> dict:
+        """``/metrics`` ``census`` section. The history* / maxListed
+        keys mirror CensusConfig fields (dfslint DFS005 checks the
+        config ⇄ CLI ⇄ metrics mapping)."""
+        c = self.cfg.census
+        return {"historyIntervalS": c.history_interval_s,
+                "historySlots": c.history_slots,
+                "coarseEvery": c.history_coarse_every,
+                "coarseSlots": c.history_coarse_slots,
+                "maxListed": c.max_listed,
+                "history": self.history.stats()
+                if self.history is not None else {"enabled": False},
+                "capacity": self._capacity_summary(),
+                "lastCensus": self._last_census}
+
+    async def census_inventory(self, prefixes=None) -> dict:
+        """This node's census contribution: the bucketed CAS inventory
+        (one bounded read-pool job), disk headroom, and the serve
+        cache's bounded top-K temperature stats (ROADMAP item 3's
+        demotion-policy seed). ``prefixes`` adds member digest lists
+        for those buckets (the drill-down pass)."""
+        inv = await self.cas.inventory(prefixes,
+                                       list_cap=self._CENSUS_LIST_CAP)
+        inv["nodeId"] = self.cfg.node_id
+        inv["disk"] = await asyncio.to_thread(self._disk_usage)
+        cache = self.serve.cache
+        inv["cacheTemperature"] = cache.temperature() \
+            if cache is not None else []
+        return inv
+
+    async def census_report(self, cluster: bool = True) -> dict:
+        """The replication-health census (GET /census, CLI ``census`` /
+        ``df``): fan out ``get_census`` summaries to every peer
+        (bounded, partial on dead peers — the /trace /doctor
+        discipline), compare each node's bucket summary against the
+        expectation derived from this node's manifests, drill only the
+        mismatched buckets, and emit the replication histogram plus
+        bounded under-replicated / orphaned / over-replicated lists
+        (obs/census.py). Data-health findings are journaled
+        (census_underreplicated / census_orphan), stamped with the
+        active trace id."""
+        from dfs_tpu.obs import census as census_mod
+
+        ids = self.cfg.cluster.sorted_ids()
+        rf = self.cfg.cluster.replication_factor
+        manifests = await asyncio.to_thread(self.store.manifests.list)
+        expected, lengths, logical = await asyncio.to_thread(
+            census_mod.expected_state, manifests, ids, rf)
+        peers = self._peers() if cluster else []
+        inventories: dict[int, dict | None] = {
+            self.cfg.node_id: await self.census_inventory()}
+
+        async def one(peer) -> tuple[int, dict | None]:
+            try:
+                inv = await self.client.get_census(peer, retries=1)
+                return peer.node_id, inv if isinstance(inv, dict) else None
+            # not silent: a None inventory IS the partial-result signal
+            # (peersFailed + unknown copies in the report)
+            except RpcError:  # dfslint: ignore[DFS007]
+                return peer.node_id, None
+
+        for nid, inv in await asyncio.gather(*(one(p) for p in peers)):
+            inventories[nid] = inv
+        failed = sum(1 for v in inventories.values() if v is None)
+
+        # drill pass: only buckets whose summary mismatches expectation
+        # move digest lists, capped per node (census_mod.DRILL_BUCKET_CAP)
+        exp_by_node = await asyncio.to_thread(
+            census_mod.summarize_expected, expected, lengths)
+        drill_want: dict[int, list[str]] = {}
+        for nid, inv in inventories.items():
+            if inv is None:
+                continue
+            mism = census_mod.diff_buckets(
+                exp_by_node.get(nid, {}), inv.get("buckets") or {})
+            if mism:
+                drill_want[nid] = mism[:census_mod.DRILL_BUCKET_CAP]
+
+        async def drill(nid: int, want: list[str]
+                        ) -> tuple[int, dict]:
+            if nid == self.cfg.node_id:
+                inv = await self.cas.inventory(
+                    want, list_cap=self._CENSUS_LIST_CAP)
+                return nid, inv.get("listed") or {}
+            try:
+                inv = await self.client.get_census(
+                    self.cfg.cluster.peer(nid), prefixes=want, retries=1)
+                return nid, (inv or {}).get("listed") or {}
+            # not silent: an unanswered drill leaves its buckets in the
+            # report's uncheckedBuckets count (build_report)
+            except RpcError:  # dfslint: ignore[DFS007]
+                return nid, {}
+
+        drilled: dict[int, dict] = {}
+        for nid, listed in await asyncio.gather(
+                *(drill(n, w) for n, w in drill_want.items())):
+            drilled[nid] = listed
+
+        report = await asyncio.to_thread(
+            census_mod.build_report, expected, lengths, inventories,
+            drilled, self.cfg.census.max_listed)
+
+        # capacity / df section: per-node and cluster byte accounting
+        nodes_cap: dict[str, dict | None] = {}
+        cluster_bytes = cluster_chunks = 0
+        for nid in sorted(inventories):
+            inv = inventories[nid]
+            if inv is None:
+                nodes_cap[str(nid)] = None
+                continue
+            disk = inv.get("disk") or {}
+            nodes_cap[str(nid)] = {
+                "casBytes": inv.get("bytes", 0),
+                "casChunks": inv.get("chunks", 0),
+                "diskFreeBytes": disk.get("freeBytes"),
+                "diskTotalBytes": disk.get("totalBytes"),
+                "cacheTemperature": inv.get("cacheTemperature") or []}
+            cluster_bytes += inv.get("bytes", 0)
+            cluster_chunks += inv.get("chunks", 0)
+        unique_bytes = sum(lengths.values())
+        report["capacity"] = {
+            "nodes": nodes_cap,
+            "clusterCasBytes": cluster_bytes,
+            "clusterChunks": cluster_chunks,
+            "logicalBytes": logical,
+            "uniqueBytes": unique_bytes,
+            "dedupRatio": round(logical / unique_bytes, 6)
+            if unique_bytes else 0.0}
+        report["coordinator"] = self.cfg.node_id
+        report["now"] = time.time()
+        report["peersFailed"] = failed
+
+        # flight-recorder correlation: data-health incidents get dated,
+        # trace-stamped journal entries (the `events` / doctor surface)
+        if report["underReplicatedTotal"]:
+            self.obs.event(
+                "census_underreplicated",
+                count=report["underReplicatedTotal"],
+                sample=[f["digest"][:12]
+                        for f in report["underReplicated"][:4]])
+        if report["orphanedTotal"]:
+            self.obs.event(
+                "census_orphan", count=report["orphanedTotal"],
+                sample=[f["digest"][:12]
+                        for f in report["orphaned"][:4]])
+        self._last_census = {"at": report["now"],
+                             "underReplicated":
+                             report["underReplicatedTotal"],
+                             "orphaned": report["orphanedTotal"],
+                             "overReplicated":
+                             report["overReplicatedTotal"],
+                             "peersFailed": failed}
+        self.counters.inc("census_runs")
+        return report
 
     def list_files(self) -> list[dict]:
         return [{"fileId": m.file_id, "name": m.name, "size": m.size,

@@ -48,6 +48,18 @@ Rules (each produces ``{"rule", "severity", "peers", "evidence"}``):
                        lifetime ``maxLagS`` is the old-build fallback).
                        Something occupied the loop; see its journal
                        for when.
+- ``capacity_trend`` — trend-aware disk-full ETA (r12): a node's CAS
+                       byte gauge is growing (history-sampler slope,
+                       ``capacity.growthBytesPerS``) fast enough that
+                       its disk free space runs out within 24 h
+                       (warning) or 1 h (critical). Needs the census
+                       history sampler on; quiet otherwise.
+- ``underreplication`` — CRITICAL: digests below their replication
+                       factor — the node's live repair queue
+                       (``underReplicated``) or a recent census's
+                       findings (``census.underReplicated``). Durability
+                       is the one promise this system makes; this rule
+                       is the loudest one in the table.
 
 Thresholds live here as module constants, documented in
 docs/observability.md; the bench's injected-slow-peer scenario
@@ -62,6 +74,14 @@ CLOCK_SKEW_S = 2.0
 CACHE_MIN_LOOKUPS = 1024   # judge thrash only with real traffic
 CACHE_HIT_FLOOR = 0.5
 CREDIT_STALL_MIN_S = 1.0
+CAPACITY_ETA_WARN_S = 24 * 3600.0   # disk full within a day: warning
+CAPACITY_ETA_CRIT_S = 3600.0        # within the hour: critical
+CENSUS_STALE_S = 900.0  # census findings older than this stop firing
+                        # the underreplication rule: the census is
+                        # pull-only, so a days-old snapshot must not
+                        # latch a healed cluster critical forever (the
+                        # r11 shed_storm/loop_lag no-latch discipline);
+                        # the LIVE repair queue keeps firing regardless
 
 
 def _median(xs: list[float]) -> float:
@@ -275,8 +295,64 @@ def diagnose(snapshots: dict[int, dict | None],
                                 f" ({sent.get('incidents', 0)} incidents"
                                 " since boot — see its /events journal)"})
 
+    def capacity_trend() -> None:
+        # trend-aware disk-full ETA: free bytes / CAS growth slope
+        # (history-sampler material — quiet when sampling is off or the
+        # store is shrinking/steady). The slope is an over-the-window
+        # average, so a one-burst upload decays out of the estimate as
+        # the fine ring advances (no latching).
+        for nid, snap in sorted(live.items()):
+            cap = snap.get("capacity") or {}
+            growth = cap.get("growthBytesPerS")
+            free = (snap.get("disk") or {}).get("freeBytes")
+            if not isinstance(growth, (int, float)) or growth <= 0 \
+                    or not isinstance(free, (int, float)):
+                continue
+            eta = free / growth
+            if eta <= CAPACITY_ETA_WARN_S:
+                findings.append({
+                    "rule": "capacity_trend",
+                    "severity": "critical" if eta <= CAPACITY_ETA_CRIT_S
+                    else "warning",
+                    "peers": [nid],
+                    "evidence": f"disk full in ~{eta / 3600:.1f}h at the "
+                                f"current CAS growth rate "
+                                f"({growth / 2**20:.2f} MiB/s, "
+                                f"{free / 2**30:.2f} GiB free)"})
+
+    def underreplication() -> None:
+        # durability red line: the node's live repair queue, or the
+        # last census this node coordinated, says digests sit below
+        # their replication factor. Critical — every other finding is
+        # about speed; this one is about data loss exposure.
+        for nid, snap in sorted(live.items()):
+            queue = snap.get("underReplicated") or 0
+            census = snap.get("census") or {}
+            seen = 0
+            if isinstance(census, dict):
+                # freshness gate against the SAME node's clock (its
+                # census stamp vs its snapshot capture time — no
+                # cross-node skew in the comparison)
+                at, now = census.get("at"), snap.get("now")
+                if isinstance(at, (int, float)) \
+                        and isinstance(now, (int, float)) \
+                        and now - at <= CENSUS_STALE_S:
+                    seen = census.get("underReplicated") or 0
+            if not isinstance(queue, int):
+                queue = 0
+            if not isinstance(seen, int):
+                seen = 0
+            if queue or seen:
+                findings.append({
+                    "rule": "underreplication", "severity": "critical",
+                    "peers": [nid],
+                    "evidence": f"{max(queue, seen)} digest(s) below "
+                                f"replication factor (repair queue "
+                                f"{queue}; last census {seen})"})
+
     for rule in (dead_peer, slow_peer, shed_storm, credit_starvation,
-                 cache_thrash, clock_skew, config_drift, loop_lag):
+                 cache_thrash, clock_skew, config_drift, loop_lag,
+                 capacity_trend, underreplication):
         try:
             rule()
         except Exception as e:   # noqa: BLE001 — see docstring

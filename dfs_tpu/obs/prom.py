@@ -167,5 +167,31 @@ def render_node_metrics(node) -> str:
         fam("dfs_loop_lag_seconds", "gauge")
         lines.append(
             f'dfs_loop_lag_seconds {_fmt(sentinel["lastLagS"])}')
+    # census/capacity plane (r12): last-sampled gauges from the history
+    # ring — never a store scan on the scrape path. getattr-guarded:
+    # standalone tools and test fakes render without a census plane.
+    census_stats = getattr(node, "census_stats", None)
+    if census_stats is not None:
+        cs = census_stats()
+        cap = cs.get("capacity") or {}
+        if cap.get("enabled"):
+            for key, fam_name in (("casBytes", "dfs_cas_bytes"),
+                                  ("casChunks", "dfs_cas_chunks"),
+                                  ("diskFreeBytes",
+                                   "dfs_disk_free_bytes"),
+                                  ("diskTotalBytes",
+                                   "dfs_disk_total_bytes")):
+                v = cap.get(key)
+                if isinstance(v, (int, float)):
+                    fam(fam_name, "gauge")
+                    lines.append(f"{fam_name} {_fmt(v)}")
+        last = cs.get("lastCensus") or {}
+        if last:
+            fam("dfs_census_under_replicated", "gauge")
+            lines.append(f"dfs_census_under_replicated "
+                         f"{last.get('underReplicated', 0)}")
+            fam("dfs_census_orphaned", "gauge")
+            lines.append(f"dfs_census_orphaned "
+                         f"{last.get('orphaned', 0)}")
     lines.append("# EOF")   # OpenMetrics required terminator
     return "\n".join(lines) + "\n"

@@ -78,8 +78,12 @@ class ServingTier:
             "admission": self.admission.stats(),
             "readaheadBatches": self.readahead_batches,
         }
-        out["cache"] = self.cache.stats() if self.cache is not None \
-            else {"enabled": False}
+        if self.cache is not None:
+            out["cache"] = self.cache.stats()
+            # bounded top-K per-digest temperature (census/tiering seed)
+            out["cache"]["temperature"] = self.cache.temperature()
+        else:
+            out["cache"] = {"enabled": False}
         return out
 
 

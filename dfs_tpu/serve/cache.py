@@ -23,11 +23,14 @@ critical sections are dict/pointer ops, never I/O or hashing).
 
 from __future__ import annotations
 
+import heapq
 import threading
+import time
 
 
 class _Node:
-    __slots__ = ("key", "data", "visited", "newer", "older")
+    __slots__ = ("key", "data", "visited", "newer", "older", "hits",
+                 "last")
 
     def __init__(self, key: str, data: bytes) -> None:
         self.key = key
@@ -35,6 +38,10 @@ class _Node:
         self.visited = False
         self.newer: _Node | None = None
         self.older: _Node | None = None
+        # per-digest temperature (census/tiering seed): hit count and
+        # last-access wall time, read by temperature() top-K
+        self.hits = 0
+        self.last = 0.0
 
 
 class ChunkCache:
@@ -67,6 +74,8 @@ class ChunkCache:
                 self.misses += 1
                 return None
             node.visited = True       # lazy promotion: no list movement
+            node.hits += 1
+            node.last = time.time()
             self.hits += 1
             return memoryview(node.data).toreadonly()
 
@@ -166,3 +175,19 @@ class ChunkCache:
                     "bytes": self._bytes, "entries": len(self._map),
                     "hits": self.hits, "misses": self.misses,
                     "inserts": self.inserts, "evictions": self.evictions}
+
+    def temperature(self, k: int = 16) -> list[dict]:
+        """Bounded top-K hottest resident digests — per-entry hit count
+        + last-access wall time, hottest first. This is the read surface
+        the hot/cold tiering policy (ROADMAP item 3) will demote from:
+        a digest with high hits and a recent last-access is exactly what
+        must NOT leave 3x replication for an EC stripe. Exposed through
+        ``/metrics`` (serve.cache.temperature) and the census snapshot.
+        O(entries) under the lock — the entry count is budget-bounded
+        and this is a diagnostics read, not a data-plane hop."""
+        with self._lock:
+            top = heapq.nlargest(max(0, int(k)), self._map.values(),
+                                 key=lambda n: (n.hits, n.last))
+        return [{"digest": n.key, "hits": n.hits,
+                 "bytes": len(n.data), "lastAccess": round(n.last, 3)}
+                for n in top if n.hits > 0]

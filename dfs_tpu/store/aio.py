@@ -147,6 +147,21 @@ class AsyncChunkStore:
             lambda: [self.store.put(d, b, verify=verify) for d, b in its],
             "cas.put_many")
 
+    async def inventory(self, list_prefixes=None,
+                        list_cap: int = 4096) -> dict:
+        """Bucketed CAS census scan (:meth:`ChunkStore.inventory`) as
+        ONE read-pool job — a readdir+stat pass over the whole store
+        (or, with ``list_prefixes``, a readdir of exactly those
+        buckets — the drill-down never re-pays the full scan), which
+        must ride the bounded batch lane like every other store-wide
+        touch (a census fan-out must never occupy the event loop or
+        stack unbounded executor jobs)."""
+        lp = list(list_prefixes) if list_prefixes else None
+        return await self._run(
+            self._rpool,
+            lambda: self.store.inventory(lp, list_cap=list_cap),
+            "cas.inventory")
+
     @property
     def pending(self) -> int:
         """Jobs submitted but not yet finished (queued + running)."""

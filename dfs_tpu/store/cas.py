@@ -80,6 +80,7 @@ class ChunkStore:
         self.root.mkdir(parents=True, exist_ok=True)
         self._root_str = os.fspath(self.root)
         self._count: int | None = None     # lazy; maintained by put/delete
+        self._bytes: int | None = None     # lazy; maintained by put/delete
         self._count_lock = threading.Lock()   # puts run in to_thread pools
         self._dirs: set[str] = set()       # subdirs known to exist
         self._tmp_seq = itertools.count()  # cheap unique tmp names
@@ -165,6 +166,8 @@ class ChunkStore:
         with self._count_lock:
             if self._count is not None:
                 self._count += 1
+            if self._bytes is not None:
+                self._bytes += len(data)
         return True
 
     def get(self, digest: str) -> bytes | None:
@@ -175,11 +178,19 @@ class ChunkStore:
             return None
 
     def delete(self, digest: str) -> bool:
+        p = self._path_str(digest)
         try:
-            os.unlink(self._path_str(digest))
+            # size BEFORE unlink, for the cached byte gauge; losing the
+            # stat→unlink race to a concurrent delete means the unlink
+            # raises and neither gauge moves — same story as put's
+            # exactly-one-True link race
+            size = os.path.getsize(p)
+            os.unlink(p)
             with self._count_lock:
                 if self._count is not None:
                     self._count -= 1
+                if self._bytes is not None:
+                    self._bytes -= size
             return True
         except FileNotFoundError:
             return False
@@ -216,6 +227,99 @@ class ChunkStore:
 
     def total_bytes(self) -> int:
         return sum((self.root / d[:2] / d).stat().st_size for d in self.digests())
+
+    def bytes_total(self) -> int:
+        """CAS payload bytes, O(1) after the first call — the capacity
+        gauge the census history sampler reads every ~10 s, which must
+        never re-pay ``total_bytes()``'s stat-per-chunk scan (the same
+        scaling trap ``count()`` already documents). Primed by one
+        ``inventory()`` pass outside the lock, then maintained by
+        put/delete; the same external-writes skew caveat as the count
+        applies (re-primed on restart)."""
+        if self._bytes is None:
+            n = self.inventory()["bytes"]   # primes both gauges
+            with self._count_lock:
+                if self._bytes is None:
+                    self._bytes = n
+        with self._count_lock:
+            return self._bytes
+
+    # digest-prefix census buckets: 2 hex chars = 256 buckets, matching
+    # the on-disk fan-out (chunks/<d[:2]>/<digest>); the bucket hash is
+    # the XOR of each member digest's leading 64 bits — order-free,
+    # incremental, and computable from a manifest walk alone, so a
+    # coordinator can compare EXPECTED bucket membership against this
+    # observed summary without moving any digest list (obs/census.py)
+    PREFIX_HEX = 2
+    STAMP_HEX = 16
+
+    @staticmethod
+    def digest_stamp(digest: str) -> int:
+        return int(digest[:ChunkStore.STAMP_HEX], 16)
+
+    def inventory(self, list_prefixes=None, list_cap: int = 4096) -> dict:
+        """Bounded, bucketed CAS census: per digest-prefix bucket
+        ``[count, bytes, xor-hash]`` plus store totals — one readdir +
+        stat pass, run OFF the event loop via the async CAS tier
+        (:meth:`AsyncChunkStore.inventory`). Also primes the
+        count/bytes gauges.
+
+        With ``list_prefixes`` the walk is RESTRICTED to exactly those
+        buckets and returns only their sorted member-digest lists
+        (capped at ``list_cap`` each, ``listTruncated`` set when a cap
+        bit) — the census drill-down, which already has the full
+        summaries from its first pass and must not re-pay a whole-store
+        scan (or re-pay stat: names need readdir alone). Summary keys
+        stay present but zero in that mode; the gauges are untouched."""
+        hexdigits = set("0123456789abcdef")
+        if list_prefixes is not None:
+            listed: dict[str, list[str]] = {}
+            truncated = False
+            for prefix in sorted(set(list_prefixes)):
+                sub = self.root / prefix
+                names = sorted(
+                    d for d in (os.listdir(sub) if sub.is_dir() else [])
+                    if len(d) == 64 and set(d) <= hexdigits)
+                if len(names) > list_cap:
+                    names = names[:list_cap]
+                    truncated = True
+                listed[prefix] = names
+            return {"buckets": {}, "chunks": 0, "bytes": 0,
+                    "listed": listed, "listTruncated": truncated}
+        buckets: dict[str, list] = {}
+        total_n = total_b = 0
+        for sub in sorted(self.root.iterdir()) if self.root.is_dir() else []:
+            if not sub.is_dir() or len(sub.name) != self.PREFIX_HEX \
+                    or not set(sub.name) <= hexdigits:
+                continue
+            b = [0, 0, 0]
+            for p in sub.iterdir():
+                d = p.name
+                if len(d) != 64 or not set(d) <= hexdigits:
+                    continue   # crash-leaked .tmp-* and strays
+                try:
+                    size = p.stat().st_size
+                # stat racing a concurrent delete/GC: the vanished chunk
+                # is simply not in this census pass — losing the race is
+                # the ordinary case, not a failure to surface
+                except OSError:  # dfslint: ignore[DFS007]
+                    continue
+                b[0] += 1
+                b[1] += size
+                b[2] ^= self.digest_stamp(d)
+            if b[0]:
+                buckets[sub.name] = b
+                total_n += b[0]
+                total_b += b[1]
+        with self._count_lock:
+            # unconditional: the full scan is ground truth at scan time,
+            # so every census/df heals whatever skew the gauges carried
+            # (the count()-documented priming race, external writes) —
+            # at worst re-introducing the same bounded concurrent-put
+            # window instead of drifting until restart
+            self._count = total_n
+            self._bytes = total_b
+        return {"buckets": buckets, "chunks": total_n, "bytes": total_b}
 
     def sweep_tmp(self) -> int:
         """Reclaim crash-leaked ``.tmp-*`` files. ``put()`` only ever

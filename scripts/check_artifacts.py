@@ -35,7 +35,8 @@ CODE_SUFFIXES = (".py", ".cpp", ".h")
 # no committed code happens to cite them. Only enforced when linting
 # THIS repo (detected by this script's own path) — fabricated test
 # repos are exempt.
-REQUIRED_ARTIFACTS = ("OBS_r09.json", "WIRE_r10.json", "OBS2_r11.json")
+REQUIRED_ARTIFACTS = ("OBS_r09.json", "WIRE_r10.json", "OBS2_r11.json",
+                      "CENSUS_r12.json")
 
 
 def _tracked_files(root: Path) -> list[Path]:

@@ -276,7 +276,7 @@ def check_digest_boundary(project: Project) -> Iterator[Finding]:
 # from the `serve` CLI (a field without a flag silently pins a
 # deployment to the default — the drift this rule exists to catch)
 _CLI_CLASSES = ("NodeConfig", "ServeConfig", "IngestConfig", "ObsConfig",
-                "FragmenterConfig")
+                "FragmenterConfig", "CensusConfig")
 # config field -> /metrics key that surfaces it, per stats function.
 # "cas" carries cas_io_threads as its nested workers count
 # (store/aio.py stats()).
@@ -306,6 +306,13 @@ _OBS_METRIC_KEYS = {"trace_ring": "traceRing",
                     "journal_segment_bytes": "journal",
                     "sentinel_interval_s": "sentinel",
                     "sentinel_lag_s": "sentinel"}
+# census/capacity knobs surface under /metrics "census"
+# (node/runtime.py census_stats())
+_CENSUS_METRIC_KEYS = {"history_interval_s": "historyIntervalS",
+                       "history_slots": "historySlots",
+                       "history_coarse_every": "coarseEvery",
+                       "history_coarse_slots": "coarseSlots",
+                       "max_listed": "maxListed"}
 
 
 def _dataclass_fields(src: SourceFile) -> dict[str, dict[str, int]]:
@@ -459,7 +466,9 @@ def check_config_drift(project: Project) -> Iterator[Finding]:
     for src, func, cls, table in (
             (runtime, "ingest_stats", "IngestConfig", _INGEST_METRIC_KEYS),
             (serve_pkg, "stats", "ServeConfig", _SERVE_METRIC_KEYS),
-            (obs_pkg, "stats", "ObsConfig", _OBS_METRIC_KEYS)):
+            (obs_pkg, "stats", "ObsConfig", _OBS_METRIC_KEYS),
+            (runtime, "census_stats", "CensusConfig",
+             _CENSUS_METRIC_KEYS)):
         if src is None or src.tree is None or cls not in classes:
             continue
         keys = _stats_dict_keys(src, func)

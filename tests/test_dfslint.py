@@ -289,6 +289,60 @@ def test_dfs005_metrics_counterpart(tmp_path):
                            "dfs_tpu/node/runtime.py": runtime_ok}) == []
 
 
+def test_dfs005_census_fields_checked(tmp_path):
+    """r12: CensusConfig rides all three DFS005 edges — a census/history
+    field dropped from the cmd_serve constructor, and one whose
+    /metrics key vanishes from census_stats(), must both be findings;
+    the fully-wired fixture must be clean."""
+    cfg = (
+        "import dataclasses\n"
+        "@dataclasses.dataclass(frozen=True)\n"
+        "class CensusConfig:\n"
+        "    history_interval_s: float = 10.0\n"
+        "    max_listed: int = 64\n")
+    cli_missing = (
+        "from dfs_tpu.config import CensusConfig\n"
+        "def cmd_serve(args):\n"
+        "    return CensusConfig(history_interval_s=args.census_interval)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--census-interval', type=float,\n"
+        "                     default=10.0)\n")
+    runtime_ok = (
+        "class S:\n"
+        "    def census_stats(self):\n"
+        "        return {'historyIntervalS': 10.0, 'maxListed': 64}\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/cli/main.py": cli_missing,
+                            "dfs_tpu/node/runtime.py": runtime_ok})
+    assert rules_of(found) == ["DFS005"]
+    assert "CensusConfig.max_listed" in found[0].message
+
+    runtime_missing_key = (
+        "class S:\n"
+        "    def census_stats(self):\n"
+        "        return {'historyIntervalS': 10.0}\n")
+    cli_ok = (
+        "from dfs_tpu.config import CensusConfig\n"
+        "def cmd_serve(args):\n"
+        "    return CensusConfig(history_interval_s=args.census_interval,\n"
+        "                        max_listed=args.census_max_listed)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--census-interval', type=float,\n"
+        "                     default=10.0)\n"
+        "    sub.add_argument('--census-max-listed', type=int,\n"
+        "                     default=64)\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/cli/main.py": cli_ok,
+                            "dfs_tpu/node/runtime.py":
+                            runtime_missing_key})
+    assert rules_of(found) == ["DFS005"]
+    assert "maxListed" in found[0].message
+
+    assert lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                           "dfs_tpu/cli/main.py": cli_ok,
+                           "dfs_tpu/node/runtime.py": runtime_ok}) == []
+
+
 def test_dfs005_unmapped_field_needs_table_entry(tmp_path):
     cfg = (
         "import dataclasses\n"

@@ -31,7 +31,7 @@ _REASONS = {200: "OK", 201: "Created", 206: "Partial Content",
             405: "Method Not Allowed", 411: "Length Required",
             413: "Payload Too Large",
             416: "Range Not Satisfiable", 500: "Internal Server Error",
-            503: "Service Unavailable"}
+            503: "Service Unavailable", 507: "Insufficient Storage"}
 MAX_BODY = 4 * 1024 * 1024 * 1024
 # plain (Content-Length) uploads above this stream through the
 # bounded-memory ingest instead of materializing the body in node RAM
@@ -241,7 +241,8 @@ def make_http_handler(node: "StorageNodeServer"):
 _TRACED_ROUTES = frozenset({
     "/status", "/files", "/metrics", "/manifest", "/chunking", "/missing",
     "/upload_resume", "/upload", "/download", "/scrub", "/repair",
-    "/trace", "/events", "/doctor", "/census", "/metrics/history"})
+    "/trace", "/events", "/doctor", "/census", "/metrics/history",
+    "/chaos"})
 
 
 async def _serve_one(node: "StorageNodeServer",
@@ -349,6 +350,11 @@ async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
         # ADDITIVE: the pre-r09 JSON schema stays a strict subset
         snap["census"] = node.census_stats()  # capacity gauges +
         # history-sampler config/state (r12, additive like "obs")
+        snap["durability"] = node.durability_stats()  # fsync mode +
+        # barrier count (r13, additive)
+        snap["chaos"] = node.chaos_stats()  # fault-injection knobs +
+        # injected counters; {"enabled": false} on a chaos-less node
+        snap["retryBudget"] = node.client.retry_budget.stats()
         return as_json(200, snap)
 
     if method == "GET" and path == "/metrics/history":
@@ -407,6 +413,33 @@ async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
         out = await asyncio.to_thread(journal.tail, since, limit)
         out["enabled"] = True
         return as_json(200, out)
+
+    if path == "/chaos" and method in ("GET", "POST"):
+        # fault-injection control plane (docs/chaos.md): GET = active
+        # knobs + injected-fault counters; POST {knob: value, ...} =
+        # atomically swap the mutable knobs (the harness scripts
+        # inject → observe → heal scenarios this way). Hard 404 when
+        # the node was not booted with chaos enabled — the master
+        # switch is boot-only on purpose: a production node must not
+        # be fault-injectable by anyone who can reach its HTTP port.
+        if node.chaos is None:
+            return plain(404, "Chaos disabled (boot with --chaos)")
+        if method == "GET":
+            return as_json(200, node.chaos.stats())
+        if content_length is None:
+            return plain(411, "Length Required")
+        if content_length > 64 * 1024:
+            return plain(413, "Payload Too Large")
+        try:
+            knobs = json.loads(await reader.readexactly(content_length))
+            if not isinstance(knobs, dict):
+                raise ValueError("want a JSON object of chaos knobs")
+            return as_json(200, node.chaos.set(**knobs))
+        # AttributeError: a wrong-typed knob value (e.g. partition: 5)
+        # failing inside ChaosConfig validation is still a bad request
+        except (ValueError, TypeError, AttributeError,
+                UnicodeDecodeError) as e:
+            return plain(400, f"Bad chaos knobs: {e}")
 
     if method == "GET" and path == "/doctor":
         # cluster doctor: fan out per-peer snapshots (partial on dead

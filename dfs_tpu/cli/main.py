@@ -17,7 +17,8 @@ import sys
 from pathlib import Path
 
 from dfs_tpu.cli.client import NodeClient
-from dfs_tpu.config import (CDCParams, CensusConfig, ClusterConfig,
+from dfs_tpu.config import (CDCParams, CensusConfig, ChaosConfig,
+                            ClusterConfig, DurabilityConfig,
                             FragmenterConfig, IngestConfig, NodeConfig,
                             ObsConfig, ServeConfig)
 
@@ -79,7 +80,21 @@ def cmd_serve(args) -> int:
             history_slots=args.census_history_slots,
             history_coarse_every=args.census_coarse_every,
             history_coarse_slots=args.census_coarse_slots,
-            max_listed=args.census_max_listed))
+            max_listed=args.census_max_listed),
+        durability=DurabilityConfig(mode=args.durability),
+        chaos=ChaosConfig(
+            enabled=args.chaos,
+            seed=args.chaos_seed,
+            rpc_delay_s=args.chaos_rpc_delay,
+            rpc_delay_peers=args.chaos_rpc_delay_peers,
+            rpc_drop_rate=args.chaos_rpc_drop_rate,
+            partition=args.chaos_partition,
+            rpc_truncate_rate=args.chaos_rpc_truncate_rate,
+            serve_delay_s=args.chaos_serve_delay,
+            disk_error_rate=args.chaos_disk_error_rate,
+            disk_full=args.chaos_disk_full,
+            disk_delay_s=args.chaos_disk_delay,
+            crash_point=args.chaos_crash_point))
 
     async def run() -> None:
         from dfs_tpu.utils.aio import create_logged_task
@@ -530,6 +545,55 @@ def build_parser() -> argparse.ArgumentParser:
                        help="digests listed per census finding "
                             "category (under-replicated / orphaned / "
                             "over-replicated)")
+    serve.add_argument("--durability", default="fsync",
+                       choices=["fsync", "none"],
+                       help="'fsync' (default): chunk + manifest writes "
+                            "barrier file and directory before an "
+                            "upload acks (crash-durable); 'none': bare "
+                            "atomic renames (pre-r13 behavior)")
+    serve.add_argument("--chaos", action="store_true",
+                       help="enable the fault-injection plane "
+                            "(docs/chaos.md): the knobs below apply "
+                            "and POST /chaos re-scripts them live; "
+                            "without this flag NO injector exists and "
+                            "every knob is ignored")
+    serve.add_argument("--chaos-seed", type=int, default=0,
+                       help="fault-decision RNG seed (xor'd with the "
+                            "node id: per-node deterministic schedules)")
+    serve.add_argument("--chaos-rpc-delay", type=float, default=0.0,
+                       help="injected latency (s) before outbound "
+                            "storage-plane calls")
+    serve.add_argument("--chaos-rpc-delay-peers", default="",
+                       help="csv node ids the rpc delay applies to "
+                            "(empty = every peer)")
+    serve.add_argument("--chaos-rpc-drop-rate", type=float, default=0.0,
+                       help="probability an outbound call's connection "
+                            "is dropped before the frame is sent")
+    serve.add_argument("--chaos-partition", default="",
+                       help="csv node ids unreachable FROM this node "
+                            "(one-way; configure one side only for an "
+                            "asymmetric partition)")
+    serve.add_argument("--chaos-rpc-truncate-rate", type=float,
+                       default=0.0,
+                       help="probability an outbound frame is cut off "
+                            "mid-body and the connection closed")
+    serve.add_argument("--chaos-serve-delay", type=float, default=0.0,
+                       help="injected delay (s) before serving each "
+                            "inbound storage-plane op (a slow node)")
+    serve.add_argument("--chaos-disk-error-rate", type=float,
+                       default=0.0,
+                       help="probability a CAS put/get raises EIO")
+    serve.add_argument("--chaos-disk-full", action="store_true",
+                       help="every CAS put raises ENOSPC (uploads "
+                            "degrade to HTTP 507; reads keep working)")
+    serve.add_argument("--chaos-disk-delay", type=float, default=0.0,
+                       help="injected delay (s) before every CAS op "
+                            "(slow disk; runs on the CAS workers)")
+    serve.add_argument("--chaos-crash-point", default="",
+                       help="registered crash-point name (see "
+                            "dfs_tpu.chaos.CRASH_POINTS): the process "
+                            "SIGKILLs itself the first time execution "
+                            "reaches it")
     serve.set_defaults(fn=cmd_serve)
 
     sc = sub.add_parser("sidecar", help="run the chunk+hash sidecar service")

@@ -21,6 +21,7 @@ Ops mirror the reference's internal API one-to-one:
 from __future__ import annotations
 
 import asyncio
+import random
 import time
 from typing import Any
 
@@ -44,6 +45,64 @@ class RpcRemoteError(RpcError):
     (e.g. chunk not found). Says nothing about peer liveness."""
 
 
+class RetryBudget:
+    """Per-peer token bucket gating RETRY attempts (first attempts are
+    always free). Pre-r13 every failing call to a partitioned peer paid
+    its full retry envelope independently — N concurrent callers times
+    ``retries`` attempts is a retry STORM aimed at a link that is
+    already sick, and the cluster-wide cost of one partition scaled
+    with load instead of with time. The bucket makes retries a shared,
+    rate-limited resource per peer: roughly ``refill_per_s`` retries
+    per second steady-state with ``capacity`` of burst; beyond that,
+    calls fail fast after their first attempt (journaled as
+    ``retry_budget_exhausted``) — so a partition costs one budget, not
+    a storm, and the health monitor / handoff machinery (which already
+    handle a dead peer) take over immediately.
+
+    Single-threaded by design: touched only from the owning event loop
+    (the client is loop-affine like its connection pool)."""
+
+    CAPACITY = 10.0
+    REFILL_PER_S = 0.5
+
+    def __init__(self, capacity: float = CAPACITY,
+                 refill_per_s: float = REFILL_PER_S) -> None:
+        self.capacity = float(capacity)
+        self.refill_per_s = float(refill_per_s)
+        self._tokens: dict[Any, tuple[float, float]] = {}
+        self._exhausted: dict[Any, int] = {}
+
+    def take(self, peer) -> bool:
+        """Consume one retry token for ``peer``; False = budget empty
+        (the caller must fast-fail instead of retrying)."""
+        now = time.monotonic()
+        tokens, last = self._tokens.get(peer, (self.capacity, now))
+        tokens = min(self.capacity,
+                     tokens + (now - last) * self.refill_per_s)
+        if tokens >= 1.0:
+            self._tokens[peer] = (tokens - 1.0, now)
+            return True
+        self._tokens[peer] = (tokens, now)
+        self._exhausted[peer] = self._exhausted.get(peer, 0) + 1
+        return False
+
+    def stats(self) -> dict:
+        """/metrics ``retryBudget``: remaining tokens + exhaustion
+        counts per peer (ids as strings — JSON keys)."""
+        now = time.monotonic()
+        tokens = {
+            str(p): round(min(self.capacity,
+                              t + (now - last) * self.refill_per_s), 2)
+            for p, (t, last) in sorted(self._tokens.items(),
+                                       key=lambda kv: str(kv[0]))}
+        return {"capacity": self.capacity,
+                "refillPerS": self.refill_per_s,
+                "tokens": tokens,
+                "exhausted": {str(p): n for p, n in
+                              sorted(self._exhausted.items(),
+                                     key=lambda kv: str(kv[0]))}}
+
+
 class InternalClient:
     """Storage-plane RPC client with a per-peer persistent-connection
     pool. The server side keeps framed connections open across requests
@@ -58,7 +117,8 @@ class InternalClient:
 
     def __init__(self, connect_timeout_s: float = 2.0,
                  request_timeout_s: float = 10.0, retries: int = 3,
-                 coalesce_fetches: bool = False, obs=None) -> None:
+                 coalesce_fetches: bool = False, obs=None,
+                 chaos=None) -> None:
         self.connect_timeout_s = connect_timeout_s
         self.request_timeout_s = request_timeout_s
         self.retries = retries
@@ -68,6 +128,18 @@ class InternalClient:
         # server span parents to it. None (the pre-r09 behavior, and
         # what standalone tools get) changes nothing on the wire.
         self._obs = obs
+        # Chaos seam (dfs_tpu.chaos): when set, every call first asks
+        # the injector about partitions / link latency / drops /
+        # truncation. None (the default everywhere outside an enabled
+        # ChaosConfig) is one branch per call.
+        self._chaos = chaos
+        # retry storms: retries (never first attempts) draw from a
+        # per-peer token bucket; exhaustion -> fast-fail (see RetryBudget)
+        self.retry_budget = RetryBudget()
+        # decorrelated-jitter backoff draws; independent of the chaos
+        # layer's deterministic decision stream on purpose (backoff
+        # timing is not part of the fault schedule)
+        self._backoff_rng = random.Random()
         self._pool: dict[tuple[str, int], list[FrameConnection]] = {}
         # Per-(peer, digest) single-flight for get_chunk: with the
         # serving tier on, concurrent readers racing to the SAME replica
@@ -136,12 +208,33 @@ class InternalClient:
                          timeout_s: float | None = None,
                          acct: dict | None = None
                          ) -> tuple[dict, memoryview]:
+        chaos = self._chaos
+        if chaos is not None:
+            op = str(header.get("op"))
+            # partition: fail before dialing (one-way — only THIS
+            # side's sends die); delay/drop: link faults before the
+            # frame goes out. All raise OSError subclasses, so the
+            # retry/budget/backoff machinery below handles injected
+            # faults exactly like real ones.
+            chaos.check_partition(peer.node_id, op)
+            await chaos.before_rpc(peer.node_id, op)
         conn = self._checkout(peer)
         reused = conn is not None
         if conn is None:
             conn = await asyncio.wait_for(
                 FrameConnection.connect(peer.host, peer.internal_port),
                 timeout=self.connect_timeout_s)
+        if chaos is not None and chaos.truncate_now(peer.node_id,
+                                                    str(header.get("op"))):
+            # torn frame: prefix promises the full body, half arrives,
+            # connection closes — the receiver's mid-frame teardown
+            # path (wire fuzz coverage) exercised on a live cluster
+            try:
+                conn.send_torn(header, body)
+            finally:
+                conn.close()
+            raise ConnectionResetError(
+                f"chaos: truncated frame to node {peer.node_id}")
         try:
             resp, rbody = await self._request(conn, header, body,
                                               timeout_s, acct)
@@ -221,6 +314,13 @@ class InternalClient:
                     bytes_out=acct["out"], bytes_in=acct["in"],
                     error=failed)
 
+    # decorrelated-jitter backoff bounds (Brooker, "Exponential Backoff
+    # And Jitter"): sleep_n = min(CAP, uniform(BASE, 3 * sleep_{n-1})).
+    # Jitter decorrelates the N callers a partition makes fail at the
+    # same instant; the cap bounds a single call's worst-case stall.
+    _BACKOFF_BASE_S = 0.05
+    _BACKOFF_CAP_S = 0.5
+
     async def _call_retrying(self, peer: PeerAddr, header: dict,
                              body, retries: int | None,
                              timeout_s: float | None,
@@ -229,16 +329,34 @@ class InternalClient:
         attempts = retries if retries is not None else self.retries
         op = header.get("op")
         last: Exception | None = None
+        prev_sleep = self._BACKOFF_BASE_S
         for attempt in range(attempts):
-            if attempt and self._obs is not None:
-                self._obs.rpc_client.retry(peer.node_id, str(op))
-                # journal the retry (flight recorder): a retry storm on
-                # one peer is the classic early sign of a sick link, and
-                # the per-call metrics only keep totals, not WHEN
-                self._obs.event("rpc_retry", peer=peer.node_id,
-                                op=str(op), attempt=attempt,
-                                cause=type(last).__name__ if last
-                                else None)
+            if attempt:
+                # retries draw from the per-peer budget; an empty
+                # bucket means this peer is already eating a storm —
+                # fail fast and let the health/handoff machinery (which
+                # already handles a dead peer) take over
+                if not self.retry_budget.take(peer.node_id):
+                    if self._obs is not None:
+                        self._obs.event("retry_budget_exhausted",
+                                        peer=peer.node_id, op=str(op),
+                                        attempt=attempt,
+                                        cause=type(last).__name__
+                                        if last else None)
+                    raise RpcUnreachable(
+                        f"peer {peer.node_id} retry budget exhausted "
+                        f"after {attempt} attempt(s): "
+                        f"{type(last).__name__}: {last}")
+                if self._obs is not None:
+                    self._obs.rpc_client.retry(peer.node_id, str(op))
+                    # journal the retry (flight recorder): a retry storm
+                    # on one peer is the classic early sign of a sick
+                    # link, and the per-call metrics only keep totals,
+                    # not WHEN
+                    self._obs.event("rpc_retry", peer=peer.node_id,
+                                    op=str(op), attempt=attempt,
+                                    cause=type(last).__name__ if last
+                                    else None)
             try:
                 return await self._call_once(peer, header, body, timeout_s,
                                              acct)
@@ -250,7 +368,11 @@ class InternalClient:
             except (OSError, asyncio.TimeoutError, RuntimeError) as e:  # dfslint: ignore[DFS007]
                 last = e
                 if attempt + 1 < attempts:
-                    await asyncio.sleep(0.05 * (attempt + 1))
+                    prev_sleep = min(
+                        self._BACKOFF_CAP_S,
+                        self._backoff_rng.uniform(self._BACKOFF_BASE_S,
+                                                  3.0 * prev_sleep))
+                    await asyncio.sleep(prev_sleep)
         if self._obs is not None:
             self._obs.event("rpc_unreachable", peer=peer.node_id,
                             op=str(op), attempts=attempts,

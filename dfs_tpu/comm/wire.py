@@ -407,6 +407,33 @@ class FrameConnection(_FrameReceiver):
         finally:
             self._waiter = None
 
+    def send_torn(self, header: dict,
+                  body: Buffer | Sequence[Buffer] = b"",
+                  keep: float = 0.5) -> None:
+        """CHAOS SEAM (dfs_tpu.chaos, docs/chaos.md): write only the
+        first ``keep`` fraction of the whole frame — prefix and header
+        included — then close, so the receiver sees a torn frame (cut
+        mid-prefix, mid-header, or mid-body: "connection closed
+        mid-frame" / torn teardown, the corruption the fuzz tests
+        cover, now injectable on a live cluster). The budget is capped
+        at total-1 bytes: a 'truncated' frame must NEVER arrive whole —
+        an empty-body control op would otherwise be delivered (and
+        executed) while the caller counts it failed. Never called
+        outside fault injection; the connection is unusable afterwards
+        by construction."""
+        head, bufs, total = encode_frame(header, body)
+        budget = min(max(0, int(total * keep)), total - 1)
+        pieces: list[Buffer] = [head, *bufs]
+        cut: list[Buffer] = []
+        for b in pieces:
+            if budget <= 0:
+                break
+            take = b[:budget] if len(b) > budget else b
+            cut.append(take)
+            budget -= len(take)
+        self._write_encoded(cut[0] if cut else b"", cut[1:])
+        self.close()
+
     def _on_frame(self, header: dict, body: memoryview,
                   frame_len: int) -> None:
         fut = self._waiter

@@ -251,6 +251,106 @@ class ObsConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class DurabilityConfig:
+    """Write-path durability policy (store/cas.py, docs/chaos.md).
+
+    ``mode="fsync"`` (the default) makes every acked byte crash-durable:
+    chunk writes fsync the payload file AND its parent directory before
+    the link/rename becomes visible, and the manifest write that acks an
+    upload fsyncs the same way — so a ``kill -9`` the instant after a
+    201 can never lose the upload (bench_chaos.py's crash-restart
+    scenario is the acceptance evidence). ``mode="none"`` restores the
+    pre-r13 behavior — atomic renames without barriers — for benches
+    and throwaway clusters where the page cache is considered durable
+    enough. Routed through :class:`AsyncChunkStore` worker threads and
+    ``asyncio.to_thread`` manifest saves, so the event loop never
+    blocks on a barrier either way."""
+
+    mode: str = "fsync"   # "fsync" | "none"
+
+    def __post_init__(self) -> None:
+        if self.mode not in ("fsync", "none"):
+            raise ValueError(f"durability mode must be 'fsync' or "
+                             f"'none', got {self.mode!r}")
+
+    @property
+    def fsync(self) -> bool:
+        return self.mode == "fsync"
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Deterministic fault injection (dfs_tpu.chaos, docs/chaos.md).
+
+    EVERYTHING defaults off: ``enabled=False`` means the node holds no
+    injector at all — every seam is one ``is None`` branch, and the
+    node's behavior is byte-identical to a chaos-less build (asserted
+    by tests/test_chaos.py). With ``enabled=True`` the node builds a
+    :class:`dfs_tpu.chaos.ChaosInjector` seeded from ``seed ^ node_id``
+    (per-node deterministic decision streams), applies the knobs below,
+    and accepts runtime re-configuration via ``POST /chaos`` — which is
+    how the cluster harness scripts scenarios (inject → observe → heal)
+    without restarting nodes. Every injected fault is journaled as a
+    trace-stamped ``chaos_inject`` event.
+
+    Fault taxonomy (see docs/chaos.md):
+    - ``rpc_delay_s`` / ``rpc_delay_peers``: outbound storage-plane
+      calls to the named peers (csv of node ids; empty = all) sleep
+      before sending — a slow link.
+    - ``rpc_drop_rate``: probability an outbound call's connection is
+      dropped mid-request (transport error, retried by the client).
+    - ``partition``: csv of peer node ids this node cannot reach AT
+      ALL. One-way by construction — configure one side only for an
+      asymmetric partition.
+    - ``rpc_truncate_rate``: probability an outbound frame is cut off
+      mid-body and the connection closed — the receiver sees a torn
+      frame (wire-level corruption).
+    - ``serve_delay_s``: inbound storage-plane ops on THIS node sleep
+      before dispatch — the whole node is slow (the doctor's
+      ``slow_peer`` evidence shape).
+    - ``disk_error_rate``: probability a CAS put/get raises EIO.
+    - ``disk_full``: every CAS put raises ENOSPC (surfaced as HTTP 507
+      by the upload path — reads keep working).
+    - ``disk_delay_s``: every CAS op sleeps first (slow disk; runs on
+      the bounded CAS worker threads, never the event loop).
+    - ``crash_point``: a registered crash-point name (see
+      ``dfs_tpu.chaos.CRASH_POINTS``); the process dies by SIGKILL the
+      first time execution reaches it.
+    """
+
+    enabled: bool = False
+    seed: int = 0
+    rpc_delay_s: float = 0.0
+    rpc_delay_peers: str = ""     # csv node ids; "" = every peer
+    rpc_drop_rate: float = 0.0
+    partition: str = ""           # csv node ids unreachable from here
+    rpc_truncate_rate: float = 0.0
+    serve_delay_s: float = 0.0
+    disk_error_rate: float = 0.0
+    disk_full: bool = False
+    disk_delay_s: float = 0.0
+    crash_point: str = ""
+
+    def __post_init__(self) -> None:
+        for f in ("rpc_delay_s", "serve_delay_s", "disk_delay_s"):
+            if getattr(self, f) < 0:
+                raise ValueError(f"{f} must be >= 0")
+        for f in ("rpc_drop_rate", "rpc_truncate_rate",
+                  "disk_error_rate"):
+            if not 0.0 <= getattr(self, f) <= 1.0:
+                raise ValueError(f"{f} must be in [0, 1]")
+        for f in ("rpc_delay_peers", "partition"):
+            spec = getattr(self, f)
+            if not isinstance(spec, str):
+                raise ValueError(f"{f} must be a csv string of node "
+                                 f"ids, got {type(spec).__name__}")
+            if spec and not all(
+                    p.strip().isdigit() for p in spec.split(",")):
+                raise ValueError(f"{f} must be a csv of node ids, "
+                                 f"got {spec!r}")
+
+
+@dataclasses.dataclass(frozen=True)
 class CensusConfig:
     """Cluster census & capacity plane (dfs_tpu.obs.census /
     obs.history — docs/observability.md).
@@ -372,6 +472,13 @@ class NodeConfig:
     # census finding-list caps; CensusConfig(history_interval_s=0)
     # disables the sampler (census queries stay available)
     census: CensusConfig = dataclasses.field(default_factory=CensusConfig)
+    # write-path durability: fsync-before-ack (default) vs bare atomic
+    # renames; DurabilityConfig(mode="none") = the pre-r13 write path
+    durability: DurabilityConfig = dataclasses.field(
+        default_factory=DurabilityConfig)
+    # deterministic fault injection (dfs_tpu.chaos); the default
+    # ChaosConfig() builds NO injector — every seam is one None check
+    chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
 
     @property
     def self_addr(self) -> PeerAddr:

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import errno
 import math
 import threading
 import time
@@ -222,7 +223,12 @@ def _config_fingerprint(cfg: NodeConfig) -> str:
 class StorageNodeServer:
     def __init__(self, cfg: NodeConfig) -> None:
         self.cfg = cfg
-        self.store = NodeStore(cfg.data_root, cfg.node_id)
+        # fsync-before-ack durability (DurabilityConfig, docs/chaos.md):
+        # chunk puts and manifest saves barrier file + directory before
+        # returning, on the CAS worker threads / to_thread — the loop
+        # never blocks on an fsync
+        self.store = NodeStore(cfg.data_root, cfg.node_id,
+                               fsync=cfg.durability.fsync)
         self.counters = Counters()
         self.latency = LatencyRecorder()
         # flight recorder (obs/journal.py): crash-safe on-disk lifecycle
@@ -245,6 +251,20 @@ class StorageNodeServer:
         # across nodes
         self._config_hash = _config_fingerprint(cfg)
         self._started_at = time.time()
+        # fault injection (dfs_tpu.chaos, docs/chaos.md): None unless
+        # ChaosConfig.enabled — every seam below is one None check, so
+        # a chaos-less node runs byte-identical code paths. Built right
+        # after obs so injected faults journal trace-stamped.
+        self.chaos = None
+        if cfg.chaos.enabled:
+            from dfs_tpu.chaos import ChaosInjector
+
+            self.chaos = ChaosInjector(cfg.chaos, cfg.node_id,
+                                       obs=self.obs)
+            # disk faults ride the ChunkStore hook: it runs on the CAS
+            # worker threads, so ENOSPC/EIO/slow-disk injection covers
+            # the AsyncChunkStore tier and every sync caller alike
+            self.store.chunks.fault = self.chaos.store_hook()
         # async CAS tier: every event-loop chunk put/get routes through a
         # bounded thread pool (store/aio.py) — the loop never blocks on
         # chunk file I/O and disk concurrency is explicit
@@ -267,7 +287,8 @@ class StorageNodeServer:
         self.client = InternalClient(cfg.connect_timeout_s,
                                      cfg.request_timeout_s, cfg.retries,
                                      coalesce_fetches=cfg.serve.cache_bytes
-                                     > 0, obs=self.obs)
+                                     > 0, obs=self.obs,
+                                     chaos=self.chaos)
         self.health = HealthMonitor(cfg.cluster, cfg.node_id, self.client,
                                     probe_interval_s=cfg.health_probe_s,
                                     obs=self.obs)
@@ -321,6 +342,16 @@ class StorageNodeServer:
         from dfs_tpu.api.http import make_http_handler
 
         addr = self.cfg.self_addr
+        # boot-time crash recovery (docs/chaos.md): BEFORE the servers
+        # listen — so nothing can be in flight — reclaim every
+        # crash-leaked temp file (all from the previous life) and run
+        # the aged orphan GC, reconciling a crash between CAS put and
+        # manifest write with the same path aborted streams already use
+        swept = await asyncio.to_thread(self.store.boot_sweep)
+        if swept["tmps"] or swept["orphans"]:
+            self.obs.event("boot_sweep", **swept)
+            self.log.info("boot sweep: %d temp(s), %d aged orphan(s)",
+                          swept["tmps"], swept["orphans"])
         # the internal plane is a BufferedProtocol server (comm/wire.py):
         # each inbound frame lands in ONE recv_into buffer and is served
         # by _serve_internal_frame — no StreamReader byte shuffling on
@@ -407,6 +438,12 @@ class StorageNodeServer:
               else contextlib.nullcontext(_NULL_OBS_SPAN)) as sp:
             sp.bytes = nbytes_in
             try:
+                if self.chaos is not None:
+                    # injected whole-node slowness (chaos serve_delay):
+                    # inside the span so traces attribute the stall to
+                    # this op, before the gate so probes feel it too —
+                    # a slow node's health answers ARE slow
+                    await self.chaos.before_serve(str(op))
                 gate = self.serve.admission.internal
                 if gate.enabled and op in _HEAVY_OPS:
                     # bounded storage-plane concurrency for the
@@ -492,7 +529,8 @@ class StorageNodeServer:
             m = Manifest.from_json(header["manifest"])
             if header.get("fresh"):
                 self.store.manifests.clear_tombstone(m.file_id)
-            if self.store.manifests.save(m):
+            # off-loop: with fsync durability the save is a disk barrier
+            if await asyncio.to_thread(self.store.manifests.save, m):
                 self.counters.inc("manifests_announced")
             else:
                 self.counters.inc("announce_rejected_tombstoned")
@@ -535,7 +573,9 @@ class StorageNodeServer:
                     "mtime": self.store.manifests.mtime(
                         header["fileId"])}, b""
         if op == "delete":
-            self._forget_file(header["fileId"])
+            # off-loop: tombstone write (an fsync barrier under the
+            # default durability mode) + the delete-triggered GC sweep
+            await asyncio.to_thread(self._forget_file, header["fileId"])
             return {"ok": True}, b""
         if op == "get_trace":
             # span query for cross-node stitching (trace_spans below):
@@ -1085,6 +1125,21 @@ class StorageNodeServer:
             out.append(cur)
         return out
 
+    def _raise_if_disk_full(self, e: OSError) -> None:
+        """ENOSPC graceful degradation (docs/chaos.md): a full local
+        disk during placement is a capacity condition, not a crash —
+        surface it as HTTP 507 (Insufficient Storage) with a journaled
+        ``disk_pressure`` event instead of a 500 traceback. Reads and
+        internal gets keep working (they never put); replication TO a
+        full node already degrades via handoff. Anything that is not
+        ENOSPC re-raises in the caller unchanged."""
+        if e.errno != errno.ENOSPC:
+            return
+        self.counters.inc("disk_full_rejects")
+        self.obs.event("disk_pressure", cause="enospc_put")
+        raise UploadError("Insufficient storage: local CAS put failed "
+                          "(ENOSPC)", status=507) from e
+
     async def _place_batch(self, file_id: str,
                            batch: list[tuple[str, bytes]],
                            stats: dict, rf: int | None = None,
@@ -1100,6 +1155,8 @@ class StorageNodeServer:
         ``placement`` pins digests to explicit holders (EC stripe
         placement) instead of the digest-derived replica set; the
         handoff ring then continues cyclically from the pinned holder."""
+        if self.chaos is not None:
+            self.chaos.maybe_crash("place.before_local_put")
         ids = self.cfg.cluster.sorted_ids()
         if rf is None:
             rf = self.cfg.cluster.replication_factor
@@ -1241,9 +1298,15 @@ class StorageNodeServer:
                     self.health.mark_dead(node_id)
 
         with self.obs.span("upload.replicate", latency=True):
-            await gather_abort_siblings(
-                put_local(local_puts),
-                *(replicate(nid, w) for nid, w in per_node.items()))
+            try:
+                await gather_abort_siblings(
+                    put_local(local_puts),
+                    *(replicate(nid, w) for nid, w in per_node.items()))
+            except OSError as e:
+                self._raise_if_disk_full(e)
+                raise
+        if self.chaos is not None:
+            self.chaos.maybe_crash("place.after_replicate")
 
         # Sloppy-quorum fallback (hinted handoff): chunks still below
         # quorum try the next nodes in their digest ring, so a dead
@@ -1294,7 +1357,11 @@ class StorageNodeServer:
                 jobs.extend(replicate(nid, w)
                             for nid, w in groups.items())
                 if jobs:
-                    await gather_abort_siblings(*jobs)
+                    try:
+                        await gather_abort_siblings(*jobs)
+                    except OSError as e:
+                        self._raise_if_disk_full(e)
+                        raise
 
         # Write-quorum policy (vs reference write-all abort, :218-221).
         failed = [d for d, n in copies.items() if n < quorum]
@@ -1323,9 +1390,22 @@ class StorageNodeServer:
         # A fresh upload clears tombstones (locally and via fresh=True at
         # peers): re-uploading deleted content must resurrect the
         # content-derived file id, not leave it permanently undownloadable.
+        # The save runs off-loop: with fsync durability it is a disk
+        # BARRIER (file + dir), and this is the write that acks the
+        # upload — the one moment the loop must not eat a barrier.
+        if self.chaos is not None:
+            self.chaos.maybe_crash("upload.before_manifest")
         self.store.manifests.clear_tombstone(manifest.file_id)
-        if not self.store.manifests.save(manifest):
+        try:
+            saved = await asyncio.to_thread(self.store.manifests.save,
+                                            manifest)
+        except OSError as e:
+            self._raise_if_disk_full(e)
+            raise
+        if not saved:
             raise UploadError("manifest save refused (tombstone race)")
+        if self.chaos is not None:
+            self.chaos.maybe_crash("upload.after_manifest")
         mj = manifest.to_json()          # once, not once per recipient
 
         async def announce(peer) -> None:
@@ -1787,7 +1867,8 @@ class StorageNodeServer:
                     continue
                 if mj:
                     manifest = Manifest.from_json(mj)
-                    self.store.manifests.save(manifest, mtime=mt)
+                    await asyncio.to_thread(self.store.manifests.save,
+                                            manifest, mt)
                     break
         if manifest is None:
             raise NotFoundError(file_id)
@@ -2303,6 +2384,21 @@ class StorageNodeServer:
                 "diskTotalBytes": h.last("capacity.diskTotalBytes"),
                 "growthBytesPerS": h.trend("capacity.casBytes")}
 
+    def durability_stats(self) -> dict:
+        """``/metrics`` ``durability`` section. The ``mode`` key mirrors
+        DurabilityConfig.mode (dfslint DFS005 checks the mapping);
+        ``fsyncs`` counts barriers the chunk store actually issued."""
+        return {"mode": self.cfg.durability.mode,
+                "fsyncs": self.store.chunks.fsync_count()}
+
+    def chaos_stats(self) -> dict:
+        """``/metrics`` ``chaos`` section: active knobs + per-kind
+        injected-fault counters (dfs_tpu.chaos.ChaosInjector.stats);
+        ``enabled: false`` for the default chaos-less node."""
+        if self.chaos is None:
+            return {"enabled": False}
+        return self.chaos.stats()
+
     def census_stats(self) -> dict:
         """``/metrics`` ``census`` section. The history* / maxListed
         keys mirror CensusConfig fields (dfslint DFS005 checks the
@@ -2492,7 +2588,8 @@ class StorageNodeServer:
         return found
 
     async def delete(self, file_id: str) -> bool:
-        found = self._forget_file(file_id)   # tombstone persists
+        # tombstone persists; written off-loop (fsync barrier + GC)
+        found = await asyncio.to_thread(self._forget_file, file_id)
 
         async def forget(peer) -> None:
             try:
@@ -2567,8 +2664,10 @@ class StorageNodeServer:
                     continue
                 # propagate with the ORIGIN timestamp (re-stamping would
                 # let the tombstone's ts creep forward as it gossips);
-                # one shared GC sweep runs after the whole round below
-                self._forget_file(fid, ts=ts, gc=False)
+                # one shared GC sweep runs after the whole round below.
+                # Off-loop: the tombstone write is an fsync barrier
+                # under the default durability mode.
+                await asyncio.to_thread(self._forget_file, fid, ts, False)
                 known.add(fid)
                 applied += 1
         if applied:
@@ -2608,9 +2707,11 @@ class StorageNodeServer:
                         m = Manifest.from_json(mj)
                     except (ValueError, KeyError):
                         continue          # corrupt peer manifest
-                    # adoption preserves the ORIGIN mtime — see save()
-                    if m.file_id == fid and self.store.manifests.save(
-                            m, mtime=mt):
+                    # adoption preserves the ORIGIN mtime — see save();
+                    # saved off-loop (fsync barrier under the default
+                    # durability mode)
+                    if m.file_id == fid and await asyncio.to_thread(
+                            self.store.manifests.save, m, mt):
                         known.add(fid)
                         adopted += 1
         if adopted:
@@ -2636,6 +2737,18 @@ class StorageNodeServer:
         own_missing: dict[str, int] = {}
         own_missing_ec: list[tuple[Manifest, list[ChunkRef]]] = []
         ec_digests: set[str] = set()
+        # One readdir snapshot of the local catalog, off the loop. It
+        # serves BOTH sides of the walk below: the own-missing checks
+        # (which previously paid a stat() per canonical digest) and the
+        # stray detection — local copies of chunks this node is NOT a
+        # canonical holder of (sloppy-quorum handoff leftovers, stale
+        # placement), candidates for relocation-by-deletion once every
+        # canonical holder is confirmed. Net cost vs pre-r13: one
+        # listing replaces thousands of stats (gc at the end of this
+        # cycle already re-lists for its own sweep, as before).
+        local_digests = set(await asyncio.to_thread(
+            self.store.chunks.digests))
+        stray: dict[str, frozenset[int]] = {}
         for m in self.store.manifests.list():
             if m.ec is not None:
                 # EC shards live at stripe-derived holders, one copy
@@ -2650,7 +2763,7 @@ class StorageNodeServer:
                     for target in pl[d]:
                         if target != self.cfg.node_id:
                             need.setdefault(target, []).append((d, ln))
-                        elif not self.store.chunks.has(d):
+                        elif d not in local_digests:
                             miss[d] = ln
                 if miss:
                     own_missing_ec.append(
@@ -2660,12 +2773,16 @@ class StorageNodeServer:
                 continue
             for c in m.chunks:
                 chunk_len[c.digest] = c.length
-                for target in replica_set(c.digest, ids, rf):
+                targets = replica_set(c.digest, ids, rf)
+                for target in targets:
                     if target != self.cfg.node_id:
                         need.setdefault(target, []).append(
                             (c.digest, c.length))
-                    elif not self.store.chunks.has(c.digest):
+                    elif c.digest not in local_digests:
                         own_missing[c.digest] = c.length
+                if self.cfg.node_id not in targets \
+                        and c.digest in local_digests:
+                    stray[c.digest] = frozenset(targets)
 
         repaired = 0
         # restore this node's OWN canonical copies first (lost to scrub
@@ -2705,6 +2822,11 @@ class StorageNodeServer:
             got = await self._gather_chunks(m, chunks=refs, strict=False)
             repaired += await restore_local(got)
         verified: set[str] = set()
+        # digest -> canonical holders CONFIRMED to hold it this cycle
+        # (has_chunks answer or push hash-echo) — the relocation pass
+        # below deletes a local stray copy only when every canonical
+        # holder is in this set, so a copy is never deleted on faith
+        confirmed: dict[str, set[int]] = {}
         for node_id, wanted in need.items():
             peer = self.cfg.cluster.peer(node_id)
             digests = sorted({d for d, _ in wanted})
@@ -2713,6 +2835,8 @@ class StorageNodeServer:
                     peer, {"op": "has_chunks", "digests": digests})
                 have = set(resp.get("have", []))
                 verified |= have
+                for d in have:
+                    confirmed.setdefault(d, set()).add(node_id)
                 to_push = sorted(set(digests) - have)
                 # local reads ride the bounded CAS pool (one job for the
                 # batch, off the loop) like every other chunk-file touch
@@ -2751,6 +2875,8 @@ class StorageNodeServer:
                         ok = {d for d, _ in part} & echoed
                         repaired += len(ok)
                         verified |= ok
+                        for d in ok:
+                            confirmed.setdefault(d, set()).add(node_id)
             except RpcError as e:
                 # journaled (DFS007): the chunks stay in
                 # under_replicated and next cycle retries, but a repair
@@ -2761,6 +2887,30 @@ class StorageNodeServer:
                 continue
         # only drop repair entries we actually confirmed on a peer
         self.under_replicated -= verified
+        # Relocation: sloppy-quorum handoff parked copies on
+        # non-canonical nodes; once every canonical holder of such a
+        # digest has CONFIRMED its copy this cycle (probe answer or
+        # push echo), the local stray is redundant and is deleted —
+        # completing the handoff round-trip the write path promises
+        # ("repair migrates them back to canonical placement") and
+        # converging the census to over-replicated == 0 after a heal.
+        # EC shards never relocate this way (stripe-pinned placement).
+        for d in ec_digests:
+            stray.pop(d, None)
+        relocated: list[str] = []
+        if stray:
+            def _relocate() -> list[str]:
+                out = []
+                for d, holders in stray.items():
+                    if holders <= confirmed.get(d, set()) \
+                            and self.store.chunks.delete(d):
+                        out.append(d)
+                return out
+
+            relocated = await asyncio.to_thread(_relocate)
+            if relocated:
+                self.serve.drop_cached(relocated)
+                self.counters.inc("relocated_chunks", len(relocated))
         # aged orphan sweep: chunks of aborted streaming uploads (placed
         # before their manifest existed, then never committed) have no
         # other reclamation path; the 1h grace keeps in-flight uploads
@@ -2769,11 +2919,12 @@ class StorageNodeServer:
         if swept:
             self.serve.drop_cached(swept)
             self.log.info("gc: swept %d aged orphan chunks", len(swept))
-        if repaired or swept:
+        if repaired or swept or relocated:
             # repair/GC decisions are exactly the state changes a
             # post-mortem needs dated — journal them (flight recorder)
             self.obs.event("repair", repaired=repaired,
                            sweptOrphans=len(swept),
+                           relocated=len(relocated),
                            underReplicated=len(self.under_replicated))
         self.counters.inc("repairs")
         return repaired

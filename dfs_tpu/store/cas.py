@@ -30,14 +30,36 @@ from dfs_tpu.utils.hashing import is_hex_digest
 from dfs_tpu.utils.hashing import sha256_hex
 
 
-def _atomic_write(path: Path | str, data: bytes) -> None:
+def _fsync_path(path: str) -> None:
+    """fsync a path by name — directories after a create/rename (the
+    entry's durability: rename/link atomicity orders the VISIBLE state,
+    but the directory block can still sit in the page cache when the
+    power goes) and files after a metadata-only change like utime
+    (write-time fsyncs don't cover it)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _atomic_write(path: Path | str, data: bytes,
+                  fsync: bool = False) -> None:
     parent = os.path.dirname(os.fspath(path)) or "."
     os.makedirs(parent, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=parent, prefix=".tmp-")
     try:
         with os.fdopen(fd, "wb") as f:
             f.write(data)
+            if fsync:
+                # payload durable BEFORE the rename makes it visible —
+                # otherwise a crash can leave the new name pointing at
+                # zero-filled blocks (rename is atomic, not a barrier)
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, path)
+        if fsync:
+            _fsync_path(parent)
     except BaseException:
         try:
             os.unlink(tmp)
@@ -73,14 +95,30 @@ def _sweep_tmp_files(dirs, max_age_s: float = _TMP_SWEEP_AGE_S) -> int:
 
 
 class ChunkStore:
-    """Flat content-addressed blob store."""
+    """Flat content-addressed blob store.
 
-    def __init__(self, root: Path) -> None:
+    ``fsync=True`` (DurabilityConfig mode "fsync", routed down by the
+    node runtime) makes every put crash-durable before it returns: the
+    payload file is fsync'd before the link makes it visible, and the
+    parent directory is fsync'd after — so an acked upload's chunks
+    survive kill -9 / power loss, not just process death. Default False
+    here: standalone/library users opt in; the node defaults on.
+
+    ``fault`` is the chaos seam (dfs_tpu.chaos): when set, every
+    put/get calls ``fault(op, digest)`` first — on the CALLING thread
+    (the bounded CAS workers), so injected ENOSPC/EIO/slow-disk faults
+    ride the real I/O paths. None (the default) costs one attribute
+    check."""
+
+    def __init__(self, root: Path, fsync: bool = False) -> None:
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
         self._root_str = os.fspath(self.root)
+        self._fsync = bool(fsync)
+        self.fault = None                  # chaos hook: fault(op, digest)
         self._count: int | None = None     # lazy; maintained by put/delete
         self._bytes: int | None = None     # lazy; maintained by put/delete
+        self._fsyncs = 0                   # barriers issued (durability_stats)
         self._count_lock = threading.Lock()   # puts run in to_thread pools
         self._dirs: set[str] = set()       # subdirs known to exist
         self._tmp_seq = itertools.count()  # cheap unique tmp names
@@ -108,7 +146,13 @@ class ChunkStore:
         FAILS if the chunk appeared meanwhile — so exactly one of two
         racing writers observes True and the cached count cannot
         double-count (content-addressed names make 'it already exists'
-        equivalent to 'it holds the right bytes')."""
+        equivalent to 'it holds the right bytes').
+
+        With ``fsync`` on, the payload file is fsync'd before the link
+        and the directory after it — the put is crash-durable when it
+        returns (the fsync-before-ack contract, docs/chaos.md)."""
+        if self.fault is not None:
+            self.fault("put", digest)
         p = self._path_str(digest)
         if os.path.isfile(p):
             return False
@@ -136,6 +180,9 @@ class ChunkStore:
         try:
             with os.fdopen(fd, "wb") as f:
                 f.write(data)
+                if self._fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
             try:
                 os.link(tmp, p)
             except FileExistsError:
@@ -156,6 +203,13 @@ class ChunkStore:
                                    errno.ENOTSUP, errno.EMLINK):
                     raise
                 os.replace(tmp, p)
+            if self._fsync:
+                # the NAME is durable only once the directory block is:
+                # link/rename ordered the visible state, the dirfd fsync
+                # makes it survive power loss (payload fsync'd above)
+                _fsync_path(parent)
+                with self._count_lock:
+                    self._fsyncs += 1
         finally:
             try:
                 os.unlink(tmp)       # ours: the O_EXCL open succeeded
@@ -170,7 +224,14 @@ class ChunkStore:
                 self._bytes += len(data)
         return True
 
+    def fsync_count(self) -> int:
+        """Durability barriers issued so far (``/metrics`` durability)."""
+        with self._count_lock:
+            return self._fsyncs
+
     def get(self, digest: str) -> bytes | None:
+        if self.fault is not None:
+            self.fault("get", digest)
         try:
             with open(self._path_str(digest), "rb") as f:
                 return f.read()
@@ -321,28 +382,51 @@ class ChunkStore:
             self._bytes = total_b
         return {"buckets": buckets, "chunks": total_n, "bytes": total_b}
 
-    def sweep_tmp(self) -> int:
+    def sweep_tmp(self, max_age_s: float = _TMP_SWEEP_AGE_S) -> int:
         """Reclaim crash-leaked ``.tmp-*`` files. ``put()`` only ever
         unlinks temps it created in THIS process; a crash between open
         and unlink leaks one, and the pid+sequence naming never revisits
-        it. The fixed hour age gate is load-bearing (deliberately not a
-        parameter): delete-triggered GC runs while puts run in thread
-        workers, and sweeping a live temp between its open and os.link
-        would fail that upload — a leaked temp older than an hour cannot
-        belong to any in-flight put."""
+        it. The hour age gate is load-bearing at RUNTIME: delete-
+        triggered GC runs while puts run in thread workers, and sweeping
+        a live temp between its open and os.link would fail that upload
+        — a leaked temp older than an hour cannot belong to any
+        in-flight put. The only caller allowed to lower ``max_age_s``
+        is the BOOT sweep (``NodeStore.boot_sweep``), which runs before
+        the servers start, when no put can be in flight — every temp on
+        disk then belongs to the previous (crashed) life."""
         dirs = [sub for sub in
                 (self.root.iterdir() if self.root.is_dir() else [])
                 if sub.is_dir()]
-        return _sweep_tmp_files(dirs)
+        return _sweep_tmp_files(dirs, max_age_s)
 
 
 class ManifestStore:
     """Per-node manifest directory; every node holds every manifest, exactly
-    like the reference's announce-to-all model (StorageNode.java:313-350)."""
+    like the reference's announce-to-all model (StorageNode.java:313-350).
 
-    def __init__(self, root: Path) -> None:
+    ``fsync=True``: manifest saves and tombstone writes are fsync'd
+    (file + directory) before returning — the manifest write is what
+    ACKS an upload, so it must be crash-durable exactly like the chunks
+    it references (fsync-before-ack, docs/chaos.md)."""
+
+    def __init__(self, root: Path, fsync: bool = False) -> None:
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
+        self._fsync = bool(fsync)
+        # serializes save() against delete() PER FILE ID: since r13
+        # both run on to_thread workers (fsync barriers must not block
+        # the event loop), so the loop no longer serializes save's
+        # is_tombstoned-check-then-write against a concurrent tombstone
+        # write — without this a delete landing inside that window
+        # would be resurrected by the late save. STRIPED, not global:
+        # the lock is held across the save's fsync barriers, and
+        # announce-to-all means every node saves every upload's
+        # manifest — one global mutex would queue every concurrent
+        # ack's disk barrier behind one file's.
+        self._mu = tuple(threading.Lock() for _ in range(16))
+
+    def _lock(self, file_id: str) -> threading.Lock:
+        return self._mu[int(file_id[:2], 16) & 15]
 
     def _path(self, file_id: str) -> Path:
         if not is_hex_digest(file_id):
@@ -383,13 +467,21 @@ class ManifestStore:
         mtime is the LWW ordering side against tombstone timestamps, and
         stamping adoption time instead would make an adopted stale
         manifest look newer than a legitimate delete."""
-        if self.is_tombstoned(m.file_id):
-            return False
-        p = self._path(m.file_id)
-        _atomic_write(p, m.to_json().encode())
-        if mtime is not None:
-            os.utime(p, (mtime, mtime))
-        return True
+        with self._lock(m.file_id):   # atomic vs delete() — __init__
+            if self.is_tombstoned(m.file_id):
+                return False
+            p = self._path(m.file_id)
+            _atomic_write(p, m.to_json().encode(), fsync=self._fsync)
+            if mtime is not None:
+                os.utime(p, (mtime, mtime))
+                if self._fsync:
+                    # the mtime IS the LWW ordering side against
+                    # tombstones — a crash reverting it to the (newer)
+                    # write time would make this adopted manifest beat
+                    # a legitimate delete; utime is metadata the write
+                    # fsync above did not cover
+                    _fsync_path(os.fspath(p))
+            return True
 
     def ids(self) -> list[str]:
         """File ids present, from filenames alone — no reads/parses (the
@@ -423,14 +515,16 @@ class ManifestStore:
         propagated — re-stamping with the local apply time would advance
         the timestamp as it gossips until it postdates (and destroys) a
         legitimate re-upload."""
-        _atomic_write(self._tomb_path(file_id),
-                      json.dumps({"ts": time.time() if ts is None
-                                  else float(ts)}).encode())
-        try:
-            self._path(file_id).unlink()
-            return True
-        except FileNotFoundError:
-            return False
+        with self._lock(file_id):   # atomic vs save() — see __init__
+            _atomic_write(self._tomb_path(file_id),
+                          json.dumps({"ts": time.time() if ts is None
+                                      else float(ts)}).encode(),
+                          fsync=self._fsync)
+            try:
+                self._path(file_id).unlink()
+                return True
+            except FileNotFoundError:
+                return False
 
     def tombstone_ts(self, file_id: str) -> float | None:
         """Deletion timestamp of a tombstone, or None if not tombstoned
@@ -446,10 +540,11 @@ class ManifestStore:
             except FileNotFoundError:
                 return None
 
-    def sweep_tmp(self) -> int:
+    def sweep_tmp(self, max_age_s: float = _TMP_SWEEP_AGE_S) -> int:
         """Reclaim crash-leaked ``_atomic_write`` temps (crash between
-        mkstemp and replace) — same hour age gate as the chunk store."""
-        return _sweep_tmp_files([self.root])
+        mkstemp and replace) — same hour age gate as the chunk store
+        (and the same boot-sweep exception)."""
+        return _sweep_tmp_files([self.root], max_age_s)
 
     def mtime(self, file_id: str) -> float | None:
         """Manifest file mtime — the 'written at' ordering side of
@@ -465,10 +560,28 @@ class NodeStore:
     Survives restarts, matching the reference's durability claim
     (README.md:179)."""
 
-    def __init__(self, data_root: Path, node_id: int) -> None:
+    def __init__(self, data_root: Path, node_id: int,
+                 fsync: bool = False) -> None:
         self.root = Path(data_root) / f"node-{node_id}"
-        self.chunks = ChunkStore(self.root / "chunks")
-        self.manifests = ManifestStore(self.root / "manifests")
+        self.chunks = ChunkStore(self.root / "chunks", fsync=fsync)
+        self.manifests = ManifestStore(self.root / "manifests",
+                                       fsync=fsync)
+
+    def boot_sweep(self) -> dict:
+        """Crash-recovery reconciliation, run ONCE at node start before
+        the servers listen (so nothing is in flight): reclaim every
+        crash-leaked temp regardless of age (they all belong to the
+        previous life), and run the AGED orphan GC — a crash between
+        CAS put and manifest write leaves durable chunks no manifest
+        references, which are exactly the aborted-stream orphans the
+        aged path already reclaims. The 1h age is kept even at boot:
+        a young orphan may belong to a manifest announced while this
+        node was down, which manifest anti-entropy adopts on the first
+        repair cycle — deleting it here would force a re-fetch."""
+        tmps = self.chunks.sweep_tmp(max_age_s=0.0) \
+            + self.manifests.sweep_tmp(max_age_s=0.0)
+        orphans = self.gc(min_age_s=3600.0)
+        return {"tmps": tmps, "orphans": len(orphans)}
 
     def gc(self, min_age_s: float = 0.0) -> list[str]:
         """Delete chunks referenced by no manifest (the reference has no

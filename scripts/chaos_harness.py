@@ -1,0 +1,473 @@
+"""Cluster chaos harness: real-process nodes + open-loop load + fault
+scripting (docs/chaos.md).
+
+The library behind ``bench_chaos.py`` and ``tests/test_chaos.py``:
+
+- :class:`ClusterHarness` — spins N separate ``dfs-tpu serve``
+  processes (the reference's operating mode, the same shape
+  tests/test_process_cluster.py runs), each booted with ``--chaos`` so
+  scenarios can re-script fault knobs live via ``POST /chaos``; knows
+  how to ``kill -9`` a node mid-flight and restart it (optionally with
+  different flags — e.g. a crash point armed).
+- :class:`LoadGen` — open-loop multi-tenant load: a scheduler thread
+  issues uploads/downloads at a fixed rate REGARDLESS of completion
+  (closed-loop generators throttle themselves exactly when the system
+  degrades — hiding the overload the harness exists to provoke), with
+  Zipf-distributed read popularity over the acked catalog. Every acked
+  upload lands in a ledger keyed by its content hash; ``verify_all``
+  later downloads every acked file and checks byte-identity (fileId IS
+  sha256(body), so hash equality is byte equality) — the zero
+  acked-write-loss invariant, mechanically checked.
+
+Invariant doctrine (ROADMAP item 4): an upload that never acked may
+vanish — its chunks are aged-GC orphans. An upload that ACKED (HTTP
+201 whose fileId matches the locally computed content hash) must read
+back byte-identical from any live node, through every fault this
+harness can inject. That asymmetry is what fsync-before-ack buys.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _sha256_hex(data: bytes) -> str:
+    from dfs_tpu.utils.hashing import sha256_hex
+
+    return sha256_hex(data)
+
+
+def _probe_free(port: int) -> bool:
+    s = socket.socket()
+    try:
+        s.bind(("127.0.0.1", port))
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def contiguous_free_ports(n: int) -> int:
+    """cmd_serve derives peer ports as base+i; find a free run of n."""
+    for _ in range(50):
+        base = _free_port()
+        if all(_probe_free(base + i) for i in range(n)):
+            return base
+    raise RuntimeError("no contiguous free port run found")
+
+
+class HarnessError(AssertionError):
+    """A scenario precondition/invariant the harness could not meet."""
+
+
+class ClusterHarness:
+    """N real ``dfs-tpu serve`` processes with the chaos plane armed."""
+
+    def __init__(self, n: int, workdir: Path, rf: int = 2,
+                 repair_interval_s: float = 1.0,
+                 extra_flags: list[str] | None = None,
+                 chaos: bool = True, env: dict | None = None) -> None:
+        self.n = n
+        self.rf = rf
+        self.workdir = Path(workdir)
+        self.workdir.mkdir(parents=True, exist_ok=True)
+        base = contiguous_free_ports(2 * n)
+        self.base_http = base
+        self.base_internal = base + n
+        self.repair_interval_s = repair_interval_s
+        self.extra_flags = list(extra_flags or [])
+        self.chaos = chaos
+        self.env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                    "PYTHONPATH": str(REPO), **(env or {})}
+        self.procs: dict[int, subprocess.Popen] = {}
+        # per-node flag overrides applied at (re)start — scenarios arm
+        # crash points by restarting a node with different flags
+        self._node_flags: dict[int, list[str]] = {}
+
+    # ---- lifecycle --------------------------------------------------- #
+
+    def http_port(self, node_id: int) -> int:
+        return self.base_http + node_id - 1
+
+    def _argv(self, node_id: int) -> list[str]:
+        argv = [sys.executable, "-m", "dfs_tpu.cli.main", "serve",
+                "--node-id", str(node_id), "--nodes", str(self.n),
+                "--base-port", str(self.base_http),
+                "--base-internal-port", str(self.base_internal),
+                "--replication-factor", str(self.rf),
+                "--fragmenter", "cdc",
+                "--data-root", str(self.workdir / "data"),
+                "--repair-interval", str(self.repair_interval_s),
+                "--probe-interval", "2"]
+        if self.chaos:
+            argv += ["--chaos"]
+        argv += self.extra_flags
+        argv += self._node_flags.get(node_id, [])
+        return argv
+
+    def start(self, node_id: int,
+              extra_flags: list[str] | None = None) -> None:
+        if extra_flags is not None:
+            self._node_flags[node_id] = list(extra_flags)
+        log = (self.workdir / f"node{node_id}.log").open("ab")
+        self.procs[node_id] = subprocess.Popen(
+            self._argv(node_id), cwd=self.workdir, env=self.env,
+            stdout=log, stderr=subprocess.STDOUT)
+
+    def start_all(self) -> None:
+        for i in range(1, self.n + 1):
+            self.start(i)
+
+    def wait_ready(self, node_ids=None, timeout: float = 90.0) -> None:
+        deadline = time.time() + timeout
+        for i in (node_ids or range(1, self.n + 1)):
+            while True:
+                p = self.procs.get(i)
+                if p is not None and p.poll() is not None:
+                    raise HarnessError(
+                        f"node {i} died during startup: "
+                        + self.node_log(i)[-2000:])
+                try:
+                    status, body = self.http(i, "GET", "/status",
+                                             timeout=2)
+                    if status == 200 and body == b"OK":
+                        break
+                except OSError:
+                    pass
+                if time.time() > deadline:
+                    raise HarnessError(f"node {i} never came up: "
+                                       + self.node_log(i)[-2000:])
+                time.sleep(0.2)
+
+    def kill9(self, node_id: int) -> None:
+        """kill -9: no shutdown path runs — what fsync-before-ack must
+        survive. Idempotent on an already-dead node."""
+        p = self.procs.get(node_id)
+        if p is None or p.poll() is not None:
+            return
+        os.kill(p.pid, signal.SIGKILL)
+        p.wait(timeout=10)
+
+    def wait_dead(self, node_id: int, timeout: float = 30.0) -> int:
+        """Block until the node process exits (a crash point firing);
+        returns the negative signal number / exit code."""
+        p = self.procs[node_id]
+        return p.wait(timeout=timeout)
+
+    def restart(self, node_id: int,
+                extra_flags: list[str] | None = None,
+                timeout: float = 90.0) -> None:
+        self.kill9(node_id)
+        self.start(node_id, extra_flags=extra_flags
+                   if extra_flags is not None else [])
+        self.wait_ready([node_id], timeout=timeout)
+
+    def stop_all(self) -> None:
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def node_log(self, node_id: int) -> str:
+        try:
+            return (self.workdir / f"node{node_id}.log").read_text(
+                errors="replace")
+        except OSError:
+            return ""
+
+    # ---- HTTP -------------------------------------------------------- #
+
+    def http(self, node_id: int, method: str, path: str,
+             body: bytes | None = None, headers: dict | None = None,
+             timeout: float = 60.0) -> tuple[int, bytes]:
+        """One HTTP request to a node; HTTP errors return (status,
+        body) instead of raising — a 503/507 is scenario DATA, not a
+        harness failure. Transport errors (dead node) raise OSError."""
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.http_port(node_id)}{path}",
+            data=body, method=method, headers=headers or {})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def get_json(self, node_id: int, path: str,
+                 timeout: float = 60.0) -> dict:
+        status, body = self.http(node_id, "GET", path, timeout=timeout)
+        if status != 200:
+            raise HarnessError(f"GET {path} on node {node_id} -> "
+                               f"{status}: {body[:200]!r}")
+        return json.loads(body)
+
+    def set_chaos(self, node_id: int, **knobs) -> dict:
+        status, body = self.http(
+            node_id, "POST", "/chaos",
+            body=json.dumps(knobs).encode(),
+            headers={"Content-Type": "application/json"}, timeout=30)
+        if status != 200:
+            raise HarnessError(f"POST /chaos on node {node_id} -> "
+                               f"{status}: {body[:200]!r}")
+        return json.loads(body)
+
+    def metrics(self, node_id: int) -> dict:
+        return self.get_json(node_id, "/metrics")
+
+    def census(self, node_id: int) -> dict:
+        return self.get_json(node_id, "/census", timeout=120)
+
+    def doctor(self, node_id: int) -> dict:
+        return self.get_json(node_id, "/doctor", timeout=120)
+
+    def trace(self, node_id: int, trace_id: str) -> dict:
+        return self.get_json(node_id, f"/trace?traceId={trace_id}")
+
+    def wait_census_clean(self, node_id: int, timeout: float = 60.0,
+                          require_no_orphans: bool = True) -> dict:
+        """Poll /census until the repair loop has converged the data
+        plane: no under-/over-replication, all peers answering (and,
+        unless the scenario aborted uploads, no orphans). Returns the
+        final report either way — the caller gates on it."""
+        deadline = time.time() + timeout
+        rep: dict = {}
+        while time.time() < deadline:
+            try:
+                rep = self.census(node_id)
+            except (OSError, HarnessError):
+                time.sleep(1.0)
+                continue
+            clean = (rep.get("peersFailed", 1) == 0
+                     and rep.get("underReplicatedTotal", 1) == 0
+                     and rep.get("overReplicatedTotal", 1) == 0
+                     and (not require_no_orphans
+                          or rep.get("orphanedTotal", 1) == 0))
+            if clean:
+                return rep
+            time.sleep(1.0)
+        return rep
+
+
+class LoadGen:
+    """Open-loop, multi-tenant Zipf load against a ClusterHarness.
+
+    A scheduler thread fires one operation every ``1/rate_per_s``
+    seconds into a worker pool, never waiting for completions (open
+    loop: offered load is independent of system health). Uploads carry
+    fresh pseudo-random payloads; the ack ledger records
+    ``fileId == sha256(payload)`` — an ack whose fileId does NOT match
+    the locally computed hash is counted as a corruption, not an ack.
+    Downloads pick a ledger entry with Zipf(popularity by recency) and
+    verify the body hashes to its fileId. Status-code counts are kept
+    per class so a scenario can assert e.g. "zero 503s" or "507s only
+    on the disk-full node"."""
+
+    def __init__(self, harness: ClusterHarness, payload_bytes: int,
+                 rate_per_s: float = 6.0, tenants: int = 3,
+                 upload_fraction: float = 0.5, seed: int = 1234,
+                 upload_nodes=None, download_nodes=None,
+                 op_timeout_s: float = 60.0) -> None:
+        import random as _random
+
+        self.h = harness
+        self.payload_bytes = payload_bytes
+        self.interval = 1.0 / rate_per_s
+        self.tenants = tenants
+        self.upload_fraction = upload_fraction
+        self.op_timeout_s = op_timeout_s
+        self._rng = _random.Random(seed)
+        self._nodes_up = list(upload_nodes
+                              or range(1, harness.n + 1))
+        self._nodes_down = list(download_nodes
+                                or range(1, harness.n + 1))
+        self._lock = threading.Lock()
+        self.ledger: list[dict] = []      # acked: {fileId, size, node}
+        self.stats = {"uploads_attempted": 0, "uploads_acked": 0,
+                      "uploads_failed": 0, "ack_hash_mismatch": 0,
+                      "downloads_attempted": 0, "downloads_ok": 0,
+                      "downloads_failed": 0, "download_mismatch": 0,
+                      "status": {}}
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._seq = 0
+
+    # ---- ops --------------------------------------------------------- #
+
+    def _payload(self, tenant: int, seq: int) -> bytes:
+        import numpy as np
+
+        rng = np.random.default_rng((tenant << 32) ^ seq ^ 0xC4A05)
+        return rng.integers(0, 256, size=self.payload_bytes,
+                            dtype=np.uint8).tobytes()
+
+    def _count_status(self, status: int) -> None:
+        with self._lock:
+            key = str(status)
+            self.stats["status"][key] = \
+                self.stats["status"].get(key, 0) + 1
+
+    def _upload_once(self, tenant: int, seq: int, node: int,
+                     trace_id: str | None = None) -> dict | None:
+        data = self._payload(tenant, seq)
+        want = _sha256_hex(data)
+        with self._lock:
+            self.stats["uploads_attempted"] += 1
+        headers = {"Content-Type": "application/octet-stream"}
+        if trace_id is not None:
+            headers["X-Dfs-Trace"] = f"{trace_id}-{os.urandom(8).hex()}"
+        try:
+            status, body = self.h.http(
+                node, "POST", f"/upload?name=t{tenant}%2Ff{seq}.bin",
+                body=data, headers=headers, timeout=self.op_timeout_s)
+        except OSError:
+            with self._lock:
+                self.stats["uploads_failed"] += 1
+            return None
+        self._count_status(status)
+        if status != 201:
+            with self._lock:
+                self.stats["uploads_failed"] += 1
+            return None
+        info = json.loads(body)
+        if info.get("fileId") != want:
+            # the server acked bytes OTHER than what was sent — a
+            # corruption-class failure, never a mere op error
+            with self._lock:
+                self.stats["ack_hash_mismatch"] += 1
+            return None
+        entry = {"fileId": want, "size": len(data), "node": node,
+                 "tenant": tenant}
+        with self._lock:
+            self.stats["uploads_acked"] += 1
+            self.ledger.append(entry)
+        return entry
+
+    def _download_once(self, entry: dict, node: int) -> bool:
+        with self._lock:
+            self.stats["downloads_attempted"] += 1
+        try:
+            status, body = self.h.http(
+                node, "GET", f"/download?fileId={entry['fileId']}",
+                timeout=self.op_timeout_s)
+        except OSError:
+            with self._lock:
+                self.stats["downloads_failed"] += 1
+            return False
+        self._count_status(status)
+        if status != 200:
+            with self._lock:
+                self.stats["downloads_failed"] += 1
+            return False
+        if len(body) != entry["size"] \
+                or _sha256_hex(body) != entry["fileId"]:
+            with self._lock:
+                self.stats["download_mismatch"] += 1
+            return False
+        with self._lock:
+            self.stats["downloads_ok"] += 1
+        return True
+
+    def _pick_zipf(self) -> dict | None:
+        """Zipf-by-recency over the acked catalog: rank 1 = newest,
+        p(rank) ∝ 1/rank^1.2 — the hot-head/long-tail read mix."""
+        with self._lock:
+            n = len(self.ledger)
+            if n == 0:
+                return None
+            weights = [1.0 / (r ** 1.2) for r in range(1, n + 1)]
+            total = sum(weights)
+            x = self._rng.random() * total
+            acc = 0.0
+            for rank, w in enumerate(weights, 1):
+                acc += w
+                if x <= acc:
+                    return self.ledger[n - rank]
+            return self.ledger[0]
+
+    # ---- open loop --------------------------------------------------- #
+
+    def _one_op(self) -> None:
+        if self._rng.random() < self.upload_fraction or not self.ledger:
+            tenant = self._rng.randrange(self.tenants)
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
+            self._upload_once(tenant, seq,
+                              self._rng.choice(self._nodes_up))
+        else:
+            entry = self._pick_zipf()
+            if entry is not None:
+                self._download_once(entry,
+                                    self._rng.choice(self._nodes_down))
+
+    def run_for(self, seconds: float) -> None:
+        """Open-loop burst: fire ops on schedule for ``seconds``, then
+        wait for the in-flight stragglers."""
+        deadline = time.time() + seconds
+        while time.time() < deadline and not self._stop.is_set():
+            t = threading.Thread(target=self._one_op, daemon=True)
+            t.start()
+            self._threads.append(t)
+            time.sleep(self.interval)
+        self.drain()
+
+    def drain(self, timeout: float = 120.0) -> None:
+        deadline = time.time() + timeout
+        for t in self._threads:
+            t.join(timeout=max(0.1, deadline - time.time()))
+        self._threads = [t for t in self._threads if t.is_alive()]
+
+    # ---- invariants -------------------------------------------------- #
+
+    def verify_all(self, nodes=None, timeout_per_file: float = 60.0
+                   ) -> dict:
+        """THE invariant: every acked upload downloads byte-identical
+        (sha256(body) == fileId) from a live node. Returns
+        {checked, ok, lost: [fileIds]}."""
+        nodes = list(nodes or range(1, self.h.n + 1))
+        lost: list[str] = []
+        with self._lock:
+            entries = list(self.ledger)
+        for i, entry in enumerate(entries):
+            node = nodes[i % len(nodes)]
+            ok = self._download_once(entry, node)
+            if not ok:
+                # one retry on a different node before declaring loss —
+                # the invariant is "readable from the CLUSTER", not
+                # "from the first node asked"
+                other = nodes[(i + 1) % len(nodes)]
+                ok = self._download_once(entry, other)
+            if not ok:
+                lost.append(entry["fileId"])
+        return {"checked": len(entries),
+                "ok": len(entries) - len(lost), "lost": lost}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = json.loads(json.dumps(self.stats))
+            out["acked"] = len(self.ledger)
+        return out

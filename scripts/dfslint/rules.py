@@ -276,7 +276,8 @@ def check_digest_boundary(project: Project) -> Iterator[Finding]:
 # from the `serve` CLI (a field without a flag silently pins a
 # deployment to the default — the drift this rule exists to catch)
 _CLI_CLASSES = ("NodeConfig", "ServeConfig", "IngestConfig", "ObsConfig",
-                "FragmenterConfig", "CensusConfig")
+                "FragmenterConfig", "CensusConfig", "DurabilityConfig",
+                "ChaosConfig")
 # config field -> /metrics key that surfaces it, per stats function.
 # "cas" carries cas_io_threads as its nested workers count
 # (store/aio.py stats()).
@@ -313,6 +314,22 @@ _CENSUS_METRIC_KEYS = {"history_interval_s": "historyIntervalS",
                        "history_coarse_every": "coarseEvery",
                        "history_coarse_slots": "coarseSlots",
                        "max_listed": "maxListed"}
+# durability mode surfaces under /metrics "durability"
+# (node/runtime.py durability_stats())
+_DURABILITY_METRIC_KEYS = {"mode": "mode"}
+# chaos knobs surface under /metrics "chaos"
+# (dfs_tpu/chaos/__init__.py ChaosInjector.stats())
+_CHAOS_METRIC_KEYS = {"enabled": "enabled", "seed": "seed",
+                      "rpc_delay_s": "rpcDelayS",
+                      "rpc_delay_peers": "rpcDelayPeers",
+                      "rpc_drop_rate": "rpcDropRate",
+                      "partition": "partition",
+                      "rpc_truncate_rate": "rpcTruncateRate",
+                      "serve_delay_s": "serveDelayS",
+                      "disk_error_rate": "diskErrorRate",
+                      "disk_full": "diskFull",
+                      "disk_delay_s": "diskDelayS",
+                      "crash_point": "crashPoint"}
 
 
 def _dataclass_fields(src: SourceFile) -> dict[str, dict[str, int]]:
@@ -415,6 +432,7 @@ def check_config_drift(project: Project) -> Iterator[Finding]:
     runtime = project.find("dfs_tpu/node/runtime.py")
     serve_pkg = project.find("dfs_tpu/serve/__init__.py")
     obs_pkg = project.find("dfs_tpu/obs/__init__.py")
+    chaos_pkg = project.find("dfs_tpu/chaos/__init__.py")
     classes = _dataclass_fields(cfg) if cfg and cfg.tree else {}
 
     # (1) every config field is wired through the serve CLI's
@@ -468,7 +486,10 @@ def check_config_drift(project: Project) -> Iterator[Finding]:
             (serve_pkg, "stats", "ServeConfig", _SERVE_METRIC_KEYS),
             (obs_pkg, "stats", "ObsConfig", _OBS_METRIC_KEYS),
             (runtime, "census_stats", "CensusConfig",
-             _CENSUS_METRIC_KEYS)):
+             _CENSUS_METRIC_KEYS),
+            (runtime, "durability_stats", "DurabilityConfig",
+             _DURABILITY_METRIC_KEYS),
+            (chaos_pkg, "stats", "ChaosConfig", _CHAOS_METRIC_KEYS)):
         if src is None or src.tree is None or cls not in classes:
             continue
         keys = _stats_dict_keys(src, func)

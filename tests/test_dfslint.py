@@ -343,6 +343,57 @@ def test_dfs005_census_fields_checked(tmp_path):
                            "dfs_tpu/node/runtime.py": runtime_ok}) == []
 
 
+def test_dfs005_chaos_fields_checked(tmp_path):
+    """r13: ChaosConfig rides the same three DFS005 edges — a chaos
+    knob dropped from cmd_serve's constructor, and one whose /metrics
+    key vanishes from ChaosInjector.stats() (the chaos-package stats
+    source), must both be findings; the wired fixture must be clean."""
+    cfg = (
+        "import dataclasses\n"
+        "@dataclasses.dataclass(frozen=True)\n"
+        "class ChaosConfig:\n"
+        "    enabled: bool = False\n"
+        "    crash_point: str = ''\n")
+    cli_missing = (
+        "from dfs_tpu.config import ChaosConfig\n"
+        "def cmd_serve(args):\n"
+        "    return ChaosConfig(enabled=args.chaos)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--chaos', action='store_true')\n")
+    chaos_ok = (
+        "class ChaosInjector:\n"
+        "    def stats(self):\n"
+        "        return {'enabled': True, 'crashPoint': ''}\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/cli/main.py": cli_missing,
+                            "dfs_tpu/chaos/__init__.py": chaos_ok})
+    assert rules_of(found) == ["DFS005"]
+    assert "ChaosConfig.crash_point" in found[0].message
+
+    cli_ok = (
+        "from dfs_tpu.config import ChaosConfig\n"
+        "def cmd_serve(args):\n"
+        "    return ChaosConfig(enabled=args.chaos,\n"
+        "                       crash_point=args.chaos_crash_point)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--chaos', action='store_true')\n"
+        "    sub.add_argument('--chaos-crash-point', default='')\n")
+    chaos_missing_key = (
+        "class ChaosInjector:\n"
+        "    def stats(self):\n"
+        "        return {'enabled': True}\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/cli/main.py": cli_ok,
+                            "dfs_tpu/chaos/__init__.py":
+                            chaos_missing_key})
+    assert rules_of(found) == ["DFS005"]
+    assert "crashPoint" in found[0].message
+
+    assert lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                           "dfs_tpu/cli/main.py": cli_ok,
+                           "dfs_tpu/chaos/__init__.py": chaos_ok}) == []
+
+
 def test_dfs005_unmapped_field_needs_table_entry(tmp_path):
     cfg = (
         "import dataclasses\n"

@@ -20,6 +20,15 @@ Zipf load, each gated on the end-to-end invariants of ROADMAP item 4:
 4. disk_full      — node 2's CAS rejects every put with ENOSPC: its
                     uploads answer 507 (never a 500 traceback), its
                     READS keep serving, other nodes ack via handoff.
+5. add_remove_node — MEMBERSHIP chaos (r14, its own 4-process cluster
+                    with the hash ring enabled and node 4 standby):
+                    node 4 joins the ring mid-ingest, is kill -9'd
+                    mid-rebalance, rejoins (resuming the migration
+                    from its persisted epoch), and is then drained
+                    back out — zero acked-write loss, zero failed
+                    reads, and the post-convergence census fully
+                    clean including overReplicated == 0 (every moved
+                    and handed-off copy relocated home).
 
 Invariants gated in EVERY scenario:
 - zero acked-write loss: every 201-acked fileId downloads back and
@@ -322,6 +331,57 @@ def scenario_disk_full(h: ClusterHarness, p: dict) -> dict:
     return out
 
 
+def scenario_add_remove_node(h: ClusterHarness, p: dict) -> dict:
+    """Membership chaos (ROADMAP item 4's add/remove-node-mid-workload
+    scenario): runs on ITS OWN 4-process cluster — ring members 1-3,
+    node 4 a reachable standby, rebalance credits set low enough that
+    the migration has a real window to be killed in."""
+    load = LoadGen(h, p["payload"], rate_per_s=p["rate"], seed=505,
+                   upload_nodes=[1, 2, 3], download_nodes=[1, 2, 3],
+                   op_timeout_s=p["op_timeout"])
+    load.run_for(p["warm_s"])
+    tid = _new_trace_id()
+    fault_thread = threading.Thread(
+        target=load.run_for, args=(p["fault_s"],), daemon=True)
+    fault_thread.start()
+    time.sleep(0.5)
+    add = h.ring_post(1, action="add", nodeId=4)   # join mid-ingest
+    time.sleep(p["kill_delay_s"])
+    h.kill9(4)                                     # die mid-rebalance
+    time.sleep(max(1.0, p["fault_s"] / 4))
+    load._upload_once(0, 999008, 1, trace_id=tid)  # traced through it
+    fault_thread.join()
+    # re-join: the restarted node resumes the migration from its
+    # persisted ring state (epoch + open window), the cluster converges
+    h.restart(4)
+    h.wait_ring_converged(add["epoch"], timeout=p["converge_s"])
+    # then drain it back out (3 -> 4 -> 3)
+    drain = h.ring_post(1, action="drain", nodeId=4)
+    h.wait_ring_converged(drain["epoch"], timeout=p["converge_s"])
+    load.drain()
+    # post-convergence: fully clean INCLUDING over-replication zero —
+    # every migrated/handed-off copy relocated home (orphans can only
+    # come from ops the kill aborted; reported, aged-GC's job)
+    rep = h.wait_census_clean(1, timeout=p["converge_s"],
+                              require_no_orphans=False)
+    verify = load.verify_all(nodes=[1, 2, 3])
+    out = _base_invariants(load, verify, _shed_count(h),
+                           _trace_nodes(h, 1, tid))
+    out.update(_census_gate(rep, require_no_orphans=False))
+    out["ring_epoch_final"] = drain["epoch"]
+    out["in_flight"] = rep.get("inFlightTotal", -1)
+    node4 = ((rep.get("capacity") or {}).get("nodes") or {}).get("4") \
+        or {}
+    out["node4_cas_chunks"] = node4.get("casChunks", -1)
+    out["node4_drained_empty"] = out["node4_cas_chunks"] == 0
+    out["ok"] = bool(out["zero_acked_loss"] and out["byte_identical"]
+                     and out["no_phantom_sheds"]
+                     and out["trace_stitchable"]
+                     and out["census_clean"]
+                     and out["node4_drained_empty"])
+    return out
+
+
 # ------------------------------------------------------------------ #
 # driver
 # ------------------------------------------------------------------ #
@@ -349,10 +409,11 @@ def run(tmp: Path, tiny: bool) -> dict:
                  "workload": {"nodes": N, "rf": RF, "tiny": tiny,
                               "durability": "fsync", **p},
                  "scenarios": {}}
-    # ONE cluster reused across scenarios (startup dominates the tiny
-    # run); every scenario heals its faults and waits for census
-    # convergence, so scenario k+1 starts from a converged cluster —
-    # contamination would fail scenario k's own census gate first
+    # ONE cluster reused across the four fault scenarios (startup
+    # dominates the tiny run); every scenario heals its faults and
+    # waits for census convergence, so scenario k+1 starts from a
+    # converged cluster — contamination would fail scenario k's own
+    # census gate first
     h = ClusterHarness(N, tmp, rf=RF, repair_interval_s=1.0)
     try:
         t0 = time.time()
@@ -371,6 +432,29 @@ def run(tmp: Path, tiny: bool) -> dict:
                 log(f"  detail: {json.dumps(res, default=str)[:800]}")
     finally:
         h.stop_all()
+    # membership scenario: its OWN 4-process cluster — hash ring on,
+    # members 1-3, node 4 standby, credits low enough that the
+    # mid-rebalance SIGKILL lands inside a real migration window
+    credit = 131072 if tiny else 262144
+    h2 = ClusterHarness(
+        4, tmp / "membership", rf=RF, repair_interval_s=1.0,
+        extra_flags=["--ring-vnodes", "64", "--ring-members", "1,2,3",
+                     "--ring-rebalance-credit-bytes", str(credit)])
+    try:
+        t0 = time.time()
+        h2.start_all()
+        h2.wait_ready()
+        res = scenario_add_remove_node(h2, p)
+        res["seconds"] = round(time.time() - t0, 1)
+        res["rebalance_credit_bytes"] = credit
+        out["scenarios"]["add_remove_node"] = res
+        log(f"scenario add_remove_node: ok={res['ok']} "
+            f"acked={res['acked']} lost={len(res['lost'])} "
+            f"sheds={res['sheds_503']} ({res['seconds']}s)")
+        if not res["ok"]:
+            log(f"  detail: {json.dumps(res, default=str)[:800]}")
+    finally:
+        h2.stop_all()
     out["ok"] = all(s["ok"] for s in out["scenarios"].values())
     return out
 

@@ -1,0 +1,287 @@
+"""Elastic-membership acceptance bench -> REBALANCE_r14.json: add then
+drain a node on a REAL 3->4->3-process cluster under open-loop load
+(dfs_tpu/ring, docs/membership.md).
+
+Topology: 4 ``dfs-tpu serve`` processes share the address book
+(``--nodes 4``) but the placement ring starts with members 1,2,3 at 64
+vnodes (``--ring-members 1,2,3 --ring-vnodes 64``) — node 4 is a
+reachable STANDBY. The scenario:
+
+1. **warm** — open-loop multi-tenant Zipf load against nodes 1-3 builds
+   an acked catalog (the LoadGen ledger: fileId == sha256(body)).
+2. **add**  — ``POST /ring {add, nodeId: 4}`` mid-load bumps the epoch;
+   every node's rebalancer streams the displaced digests to node 4
+   under the configured byte credits while reads ride the dual-read
+   window. The bench reconstructs BOTH epoch maps from ``GET /ring``
+   (placement is computable by any party from the compact map — that
+   is the point) and computes the THEORETICAL MINIMUM movement over
+   the pre-add catalog: sum of len(d) x |newOwners(d) \\ oldOwners(d)|.
+3. **drain** — ``POST /ring {drain, nodeId: 4}`` (weight 0) moves
+   everything back off; convergence must reach a fully CLEAN census
+   (over-replication zero = every stray relocated home) and node 4's
+   CAS must be EMPTY.
+4. **verify** — every acked upload downloads byte-identical.
+
+Gates (the r14 acceptance criteria):
+- zero failed reads across the whole run (dual-read window held);
+- zero acked-write loss (every 201 readable after 3->4->3);
+- moved bytes <= 1.5x the theoretical minimum + rf x bytes uploaded
+  concurrently with the move (those new digests may legitimately move
+  or place either side of the flip);
+- per-node rebalance bandwidth <= the configured credit (x1.35 for
+  token-bucket burst + measurement slack);
+- post-drain census fully clean and node 4 CAS empty.
+
+Usage: python bench_rebalance.py [--tiny] [--out PATH]
+Writes REBALANCE_r14.json (or --out) and prints it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from dfs_tpu.ring import RingMap  # noqa: E402
+from scripts.chaos_harness import (ClusterHarness, HarnessError,  # noqa: E402
+                                   LoadGen)
+
+ART = "REBALANCE_r14.json"
+N = 4
+RF = 2
+VNODES = 64
+MEMBERS0 = "1,2,3"
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _catalog(h: ClusterHarness, node_id: int = 1) -> dict[str, int]:
+    """digest -> byte length over every manifest the node holds
+    (announce-to-all: any node's manifest dir is the catalog)."""
+    out: dict[str, int] = {}
+    status, body = h.http(node_id, "GET", "/files")
+    if status != 200:
+        raise HarnessError(f"GET /files -> {status}")
+    for f in json.loads(body):
+        status, mj = h.http(node_id, "GET",
+                            f"/manifest?fileId={f['fileId']}")
+        if status != 200:
+            continue
+        for c in json.loads(mj)["chunks"]:
+            out.setdefault(c["digest"], c["length"])
+    return out
+
+
+def _ring_map(h: ClusterHarness, node_id: int = 1) -> RingMap:
+    st = h.ring_status(node_id)
+    return RingMap.from_dict({"epoch": st["epoch"],
+                              "vnodes": st["vnodes"],
+                              "members": st["members"]})
+
+
+def _min_movement(catalog: dict[str, int], old: RingMap, new: RingMap,
+                  rf: int) -> int:
+    """Theoretical minimum bytes a rebalance must move: every byte of
+    every copy that exists at a NEW owner but not at an OLD one."""
+    total = 0
+    for d, ln in catalog.items():
+        moved = set(new.owners(d, rf)) - set(old.owners(d, rf))
+        total += ln * len(moved)
+    return total
+
+
+def _rebalance_totals(h: ClusterHarness, nodes) -> dict[int, dict]:
+    out = {}
+    for i in nodes:
+        r = h.metrics(i).get("ring", {}).get("rebalance", {})
+        out[i] = {"bytesMoved": r.get("bytesMoved", 0),
+                  "pushes": r.get("pushes", 0),
+                  "creditStallS": r.get("creditStallS", 0.0),
+                  "dualReadHits": r.get("dualReadHits", 0)}
+    return out
+
+
+def _migrate(h: ClusterHarness, load: LoadGen, action: dict,
+             new_epoch: int, window_s: float, converge_s: float,
+             credit: int) -> dict:
+    """One membership change under load: snapshot the catalog + maps,
+    fire the admin action mid-load, wait for cluster-wide convergence,
+    and judge moved bytes against the theoretical minimum."""
+    nodes = list(range(1, h.n + 1))
+    pre_catalog = _catalog(h)
+    pre_ring = _ring_map(h)
+    pre_tot = _rebalance_totals(h, nodes)
+    pre_reads = load.snapshot()
+    t_load = threading.Thread(target=load.run_for, args=(window_s,),
+                              daemon=True)
+    t_load.start()
+    time.sleep(max(0.3, window_s / 6))   # change lands mid-load
+    t0 = time.time()
+    out = h.ring_post(1, **action)
+    assert out["epoch"] == new_epoch, out
+    new_ring = RingMap.from_dict(out["ring"])
+    h.wait_ring_converged(new_epoch, nodes, timeout=converge_s)
+    seconds = time.time() - t0
+    t_load.join()
+    load.drain()
+
+    post_catalog = _catalog(h)
+    post_tot = _rebalance_totals(h, nodes)
+    per_node = {
+        str(i): {k: (post_tot[i][k] - pre_tot[i][k]
+                     if isinstance(post_tot[i][k], (int, float))
+                     else post_tot[i][k])
+                 for k in post_tot[i]}
+        for i in nodes}
+    moved = sum(v["bytesMoved"] for v in per_node.values())
+    min_pre = _min_movement(pre_catalog, pre_ring, new_ring, h.rf)
+    new_bytes = sum(ln for d, ln in post_catalog.items()
+                    if d not in pre_catalog)
+    bound = 1.5 * min_pre + h.rf * new_bytes
+    # bandwidth: a node's long-run rebalance rate is credit-bounded
+    # (one-slice token-bucket overshoot + wall-clock slack -> x1.35);
+    # nodes that moved less than one credit-second cannot violate it
+    bw_ok = all(
+        v["bytesMoved"] <= credit * 1.0 or
+        v["bytesMoved"] / max(seconds, 1e-6) <= credit * 1.35
+        for v in per_node.values())
+    snap = load.snapshot()
+    reads_failed = (snap["downloads_failed"] + snap["download_mismatch"]
+                    - pre_reads["downloads_failed"]
+                    - pre_reads["download_mismatch"])
+    return {
+        "epoch": new_epoch,
+        "seconds": round(seconds, 2),
+        "moved_bytes": moved,
+        "min_bytes": min_pre,
+        "concurrent_new_bytes": new_bytes,
+        "moved_bound": round(bound),
+        "moved_within_bound": moved <= bound and min_pre > 0,
+        "bandwidth_ok": bw_ok,
+        "credit_stall_s": round(sum(v["creditStallS"]
+                                    for v in per_node.values()), 3),
+        "dual_read_hits": sum(v["dualReadHits"]
+                              for v in per_node.values()),
+        "reads_failed_during": reads_failed,
+        "per_node": per_node,
+        "catalog_digests": len(post_catalog),
+    }
+
+
+def run(tmp: Path, tiny: bool) -> dict:
+    credit = 512 * 1024 if tiny else 2 * 1024 * 1024
+    p = {"payload": 48_000 if tiny else 192_000,
+         "rate": 4.0 if tiny else 6.0,
+         "warm_s": 4.0 if tiny else 10.0,
+         "window_s": 3.0 if tiny else 8.0,
+         "converge_s": 60.0 if tiny else 120.0,
+         "op_timeout": 60.0 if tiny else 120.0}
+    out: dict = {"metric": "rebalance_invariants", "round": 14,
+                 "workload": {"nodes": N, "rf": RF, "vnodes": VNODES,
+                              "members0": MEMBERS0,
+                              "credit_bytes_per_s": credit,
+                              "tiny": tiny, **p}}
+    h = ClusterHarness(
+        N, tmp, rf=RF, repair_interval_s=1.0, chaos=False,
+        extra_flags=["--ring-vnodes", str(VNODES),
+                     "--ring-members", MEMBERS0,
+                     "--ring-rebalance-credit-bytes", str(credit)])
+    try:
+        t0 = time.time()
+        h.start_all()
+        h.wait_ready()
+        out["workload"]["startup_s"] = round(time.time() - t0, 1)
+        load = LoadGen(h, p["payload"], rate_per_s=p["rate"], seed=1414,
+                       upload_nodes=[1, 2, 3], download_nodes=[1, 2, 3],
+                       upload_fraction=0.6,
+                       op_timeout_s=p["op_timeout"])
+        load.run_for(p["warm_s"])          # seed the acked catalog
+        log(f"warm done: {load.snapshot()['acked']} acked")
+
+        # during migrations the open-loop mix turns read-heavy: the
+        # reads are what the dual-read gate exercises, and a lighter
+        # upload stream keeps the moved-vs-minimum comparison tight
+        load.upload_fraction = 0.25
+        out["add"] = _migrate(
+            h, load, {"action": "add", "nodeId": 4}, 1,
+            p["window_s"], p["converge_s"], credit)
+        log(f"add: {json.dumps(out['add']['moved_bytes'])}B moved "
+            f"(min {out['add']['min_bytes']}B) in "
+            f"{out['add']['seconds']}s")
+
+        out["drain"] = _migrate(
+            h, load, {"action": "drain", "nodeId": 4}, 2,
+            p["window_s"], p["converge_s"], credit)
+        log(f"drain: {out['drain']['moved_bytes']}B moved "
+            f"(min {out['drain']['min_bytes']}B) in "
+            f"{out['drain']['seconds']}s")
+
+        # post-drain: census fully clean (over-replication zero = every
+        # stray relocated home) and node 4 holds no chunk bytes
+        rep = h.wait_census_clean(1, timeout=p["converge_s"])
+        cap4 = ((rep.get("capacity") or {}).get("nodes")
+                or {}).get("4") or {}
+        out["census"] = {
+            "under_replicated": rep.get("underReplicatedTotal", -1),
+            "over_replicated": rep.get("overReplicatedTotal", -1),
+            "orphaned": rep.get("orphanedTotal", -1),
+            "in_flight": rep.get("inFlightTotal", -1),
+            "peers_failed": rep.get("peersFailed", -1),
+            "node4_cas_chunks": cap4.get("casChunks", -1)}
+        out["census"]["clean"] = (
+            out["census"]["under_replicated"] == 0
+            and out["census"]["over_replicated"] == 0
+            and out["census"]["orphaned"] == 0
+            and out["census"]["peers_failed"] == 0
+            and out["census"]["node4_cas_chunks"] == 0)
+
+        snap = load.snapshot()
+        out["reads_failed"] = (snap["downloads_failed"]
+                               + snap["download_mismatch"])
+        out["zero_failed_reads"] = out["reads_failed"] == 0
+        verify = load.verify_all(nodes=[1, 2, 3])
+        out["acked"] = snap["acked"]
+        out["uploads_failed"] = snap["uploads_failed"]
+        out["verified"] = verify["ok"]
+        out["lost"] = verify["lost"]
+        out["zero_acked_loss"] = not verify["lost"]
+        out["ok"] = bool(
+            out["zero_failed_reads"] and out["zero_acked_loss"]
+            and out["add"]["moved_within_bound"]
+            and out["add"]["bandwidth_ok"]
+            and out["drain"]["moved_within_bound"]
+            and out["drain"]["bandwidth_ok"]
+            and out["census"]["clean"] and out["acked"] > 0)
+    finally:
+        h.stop_all()
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tiny", action="store_true",
+                    help="tier-1 smoke mode: small payloads, short "
+                         "windows — same scenario, same gates")
+    ap.add_argument("--out", default=None,
+                    help=f"artifact path (default: {ART} next to this "
+                         "script)")
+    args = ap.parse_args(argv)
+    out_path = Path(args.out) if args.out \
+        else Path(__file__).parent / ART
+    with tempfile.TemporaryDirectory(prefix="bench_rebalance_") as tmp:
+        out = run(Path(tmp), args.tiny)
+    out_path.write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

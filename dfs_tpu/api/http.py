@@ -242,7 +242,7 @@ _TRACED_ROUTES = frozenset({
     "/status", "/files", "/metrics", "/manifest", "/chunking", "/missing",
     "/upload_resume", "/upload", "/download", "/scrub", "/repair",
     "/trace", "/events", "/doctor", "/census", "/metrics/history",
-    "/chaos"})
+    "/chaos", "/ring"})
 
 
 async def _serve_one(node: "StorageNodeServer",
@@ -355,6 +355,8 @@ async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
         snap["chaos"] = node.chaos_stats()  # fault-injection knobs +
         # injected counters; {"enabled": false} on a chaos-less node
         snap["retryBudget"] = node.client.retry_budget.stats()
+        snap["ring"] = node.ring_stats()  # membership epoch + rebalance
+        # progress (r14, additive like "obs"/"census")
         return as_json(200, snap)
 
     if method == "GET" and path == "/metrics/history":
@@ -440,6 +442,33 @@ async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
         except (ValueError, TypeError, AttributeError,
                 UnicodeDecodeError) as e:
             return plain(400, f"Bad chaos knobs: {e}")
+
+    if path == "/ring" and method in ("GET", "POST"):
+        # elastic membership admin plane (docs/membership.md): GET =
+        # epoch/members/migration status (+ every peer's epoch view);
+        # POST {"action": "add"|"drain"|"remove"|"reweight",
+        # "nodeId": N[, "weight": W]} = bump the epoch, install the new
+        # map locally, push it to every peer, and kick the rebalancer.
+        if method == "GET":
+            return as_json(200, await node.ring_status(
+                cluster=query.get("cluster", "1") != "0"))
+        if content_length is None:
+            return plain(411, "Length Required")
+        if content_length > 64 * 1024:
+            return plain(413, "Payload Too Large")
+        try:
+            body = json.loads(await reader.readexactly(content_length))
+            if not isinstance(body, dict):
+                raise ValueError("want a JSON object")
+            action = str(body.get("action", ""))
+            node_id = body.get("nodeId")
+            weight = body.get("weight")
+            return as_json(200, await node.ring_admin(
+                action,
+                node_id=int(node_id) if node_id is not None else None,
+                weight=float(weight) if weight is not None else None))
+        except (ValueError, TypeError, UnicodeDecodeError) as e:
+            return plain(400, f"Bad ring change: {e}")
 
     if method == "GET" and path == "/doctor":
         # cluster doctor: fan out per-peer snapshots (partial on dead

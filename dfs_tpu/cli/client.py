@@ -191,6 +191,26 @@ class NodeClient:
             path += "?" + urllib.parse.urlencode({"name": name})
         return json.loads(self._request("GET", path))
 
+    def ring_status(self, cluster: bool = True) -> dict:
+        """Membership ring view (GET /ring): epoch, members, migration
+        + rebalance state, peers' epoch views."""
+        q = urllib.parse.urlencode({"cluster": "1" if cluster else "0"})
+        return json.loads(self._request("GET", f"/ring?{q}"))
+
+    def ring_admin(self, action: str, node_id: int | None = None,
+                   weight: float | None = None) -> dict:
+        """Membership change (POST /ring): add/drain/remove/reweight a
+        member — the contacted node bumps the epoch, pushes the new
+        map cluster-wide and kicks the online rebalancer."""
+        body: dict = {"action": action}
+        if node_id is not None:
+            body["nodeId"] = node_id
+        if weight is not None:
+            body["weight"] = weight
+        return json.loads(self._request(
+            "POST", "/ring", body=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}))
+
     def trace(self, trace_id: str, cluster: bool = True) -> dict:
         """Spans of one trace, stitched cluster-wide by the contacted
         node (GET /trace) — render with dfs_tpu.obs.stitch.render_tree."""

@@ -20,7 +20,7 @@ from dfs_tpu.cli.client import NodeClient
 from dfs_tpu.config import (CDCParams, CensusConfig, ChaosConfig,
                             ClusterConfig, DurabilityConfig,
                             FragmenterConfig, IngestConfig, NodeConfig,
-                            ObsConfig, ServeConfig)
+                            ObsConfig, RingConfig, ServeConfig)
 
 
 def _client(args) -> NodeClient:
@@ -82,6 +82,10 @@ def cmd_serve(args) -> int:
             history_coarse_slots=args.census_coarse_slots,
             max_listed=args.census_max_listed),
         durability=DurabilityConfig(mode=args.durability),
+        ring=RingConfig(
+            vnodes=args.ring_vnodes,
+            members=args.ring_members,
+            rebalance_credit_bytes=args.ring_rebalance_credit_bytes),
         chaos=ChaosConfig(
             enabled=args.chaos,
             seed=args.chaos_seed,
@@ -339,6 +343,59 @@ def cmd_df(args) -> int:
     return 0
 
 
+def cmd_ring(args) -> int:
+    """Elastic membership admin (docs/membership.md): `ring status`
+    renders the cluster's epoch/member/migration view; `ring
+    add/drain/remove/reweight <node>` bumps the epoch on the contacted
+    node, which pushes the new map to every peer and kicks the online
+    rebalancer."""
+    c = _client(args)
+    if args.action == "status":
+        st = c.ring_status()
+        mode = st.get("mode", "?")
+        lines = [f"ring epoch {st.get('epoch')} ({mode}"
+                 + (f", {st.get('vnodes')} vnodes" if mode == "hash"
+                    else "") + ")"
+                 + (" — MIGRATING from epoch "
+                    f"{st.get('previousEpoch')}"
+                    if st.get("migrating") else "")]
+        for m in st.get("members", []):
+            w = m.get("weight", 1.0)
+            lines.append(f"  node {m.get('nodeId')}: weight {w}"
+                         + ("  (draining)" if w == 0 else ""))
+        reb = st.get("rebalance") or {}
+        if reb.get("bytesMoved"):
+            lines.append(f"  rebalance: {reb['bytesMoved']} bytes "
+                         f"moved, {reb.get('pushes', 0)} pushes, "
+                         f"creditStallS={reb.get('creditStallS', 0)}, "
+                         f"dualReadHits={reb.get('dualReadHits', 0)}")
+        for nid, p in sorted((st.get("peers") or {}).items(),
+                             key=lambda kv: int(kv[0])):
+            if p is None:
+                lines.append(f"  peer {nid}: NO ANSWER")
+            elif p.get("epoch") != st.get("epoch") or p.get("migrating"):
+                lines.append(f"  peer {nid}: epoch {p.get('epoch')}"
+                             + (" (migrating)" if p.get("migrating")
+                                else ""))
+        print("\n".join(lines))
+        if st.get("peersFailed"):
+            print(f"(warning: {st['peersFailed']} peer(s) unreachable "
+                  "— view is partial)", file=sys.stderr)
+        # scriptable: a split epoch view or unreachable peer exits 1
+        split = any(p is not None and p.get("epoch") != st.get("epoch")
+                    for p in (st.get("peers") or {}).values())
+        return 1 if split or st.get("peersFailed") else 0
+    out = c.ring_admin(args.action, node_id=args.node,
+                       weight=args.weight)
+    print(f"ring epoch {out.get('epoch')} installed "
+          f"({args.action} node {args.node}); pushed to: "
+          + ", ".join(f"{k}={'ok' if v else 'FAILED'}"
+                      for k, v in sorted(
+                          (out.get('pushed') or {}).items(),
+                          key=lambda kv: int(kv[0]))))
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Stitch + render one distributed trace (docs/observability.md):
     the contacted node gathers every peer's spans for the id and this
@@ -551,6 +608,20 @@ def build_parser() -> argparse.ArgumentParser:
                             "barrier file and directory before an "
                             "upload acks (crash-durable); 'none': bare "
                             "atomic renames (pre-r13 behavior)")
+    serve.add_argument("--ring-vnodes", type=int, default=0,
+                       help="virtual nodes per unit weight on the "
+                            "consistent-hash membership ring; 0 "
+                            "(default) = static legacy placement, "
+                            "byte-stable with pre-r14 stores")
+    serve.add_argument("--ring-members", default="",
+                       help="csv node ids owning digest space at "
+                            "epoch 0 (others are reachable standbys "
+                            "until `ring add`); empty = every peer")
+    serve.add_argument("--ring-rebalance-credit-bytes", type=int,
+                       default=8 * 1024 * 1024,
+                       help="online-rebalancer bandwidth bound "
+                            "(payload bytes/s per node); 0 = "
+                            "unthrottled")
     serve.add_argument("--chaos", action="store_true",
                        help="enable the fault-injection plane "
                             "(docs/chaos.md): the knobs below apply "
@@ -666,6 +737,20 @@ def build_parser() -> argparse.ArgumentParser:
                         help="cluster capacity: per-node CAS bytes, "
                              "disk headroom, dedup ratio")
     df.set_defaults(fn=cmd_df)
+    rg = sub.add_parser("ring",
+                        help="elastic membership: show or change the "
+                             "placement ring (epoch-versioned; "
+                             "changes rebalance online)")
+    rg.add_argument("action",
+                    choices=["status", "add", "drain", "remove",
+                             "reweight"])
+    rg.add_argument("node", type=int, nargs="?", default=None,
+                    help="target node id (required for every action "
+                         "but status)")
+    rg.add_argument("--weight", type=float, default=None,
+                    help="member weight (add/reweight); default 1.0 "
+                         "on add")
+    rg.set_defaults(fn=cmd_ring)
     tr = sub.add_parser("trace",
                         help="render a stitched cross-node trace")
     tr.add_argument("trace_id")

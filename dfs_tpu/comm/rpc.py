@@ -45,6 +45,27 @@ class RpcRemoteError(RpcError):
     (e.g. chunk not found). Says nothing about peer liveness."""
 
 
+class RingEpochMismatch(RpcRemoteError):
+    """The peer refused a placement-bearing op because our ring epochs
+    differ (docs/membership.md). Carries the peer's epoch and (when the
+    peer is ahead) its full ring map, so the stale side can refresh and
+    retry without an extra round-trip — the client's ring-aware retry
+    (:meth:`InternalClient.call`) does exactly that."""
+
+    def __init__(self, msg: str, epoch: int, ring: dict | None) -> None:
+        super().__init__(msg)
+        self.epoch = epoch
+        self.ring = ring
+
+
+# placement-bearing ops: the sender's ring epoch rides the header so a
+# stale side answers RingEpochMismatch and refreshes instead of
+# mis-placing. Metadata/diagnosis ops carry no epoch — they must work
+# exactly while the cluster is converging.
+_EPOCH_OPS = frozenset({"store_chunks", "get_chunk", "get_chunks",
+                        "has_chunks"})
+
+
 class RetryBudget:
     """Per-peer token bucket gating RETRY attempts (first attempts are
     always free). Pre-r13 every failing call to a partitioned peer paid
@@ -118,10 +139,16 @@ class InternalClient:
     def __init__(self, connect_timeout_s: float = 2.0,
                  request_timeout_s: float = 10.0, retries: int = 3,
                  coalesce_fetches: bool = False, obs=None,
-                 chaos=None) -> None:
+                 chaos=None, ring=None) -> None:
         self.connect_timeout_s = connect_timeout_s
         self.request_timeout_s = request_timeout_s
         self.retries = retries
+        # Membership seam (dfs_tpu.ring.manager.RingManager): when set,
+        # placement-bearing calls carry the ring epoch and a
+        # RingEpochMismatch reply triggers the converge-and-retry path
+        # (adopt the peer's newer map, or push ours to a stale peer).
+        # None (standalone tools) = the pre-r14 wire exactly.
+        self._ring = ring
         # Observability hook (dfs_tpu.obs): when set, every call records
         # per-peer per-op client metrics, opens an `rpc.<op>` span, and
         # attaches the trace context to the wire header so the peer's
@@ -264,6 +291,15 @@ class InternalClient:
         # sync even for an application-level error — pool it either way
         self._checkin(peer, conn)
         if not resp.get("ok", False):
+            re = resp.get("ringEpoch")
+            if isinstance(re, int) and not isinstance(re, bool):
+                # structured membership refusal: carry the peer's epoch
+                # (+ map) so call()'s converge-and-retry path can fix
+                # the stale side without an extra round-trip
+                raise RingEpochMismatch(
+                    f"peer {peer.node_id} error: {resp.get('error')}",
+                    epoch=re, ring=resp.get("ring")
+                    if isinstance(resp.get("ring"), dict) else None)
             raise RpcRemoteError(
                 f"peer {peer.node_id} error: {resp.get('error')}")
         return resp, rbody
@@ -288,10 +324,20 @@ class InternalClient:
         count/latency/bytes/errors into the client RPC table — byte
         counts are FRAME sizes (prefix + header + body), what the
         socket actually carried, summed across retry attempts."""
+        if self._ring is not None \
+                and header.get("op") in _EPOCH_OPS \
+                and "repoch" not in header:
+            # placement-bearing op: stamp the sender's ring epoch AND
+            # map fingerprint so a stale side — including one holding a
+            # DIFFERENT map at the same epoch (racing admins) — answers
+            # RingEpochMismatch instead of silently mis-placing
+            # (docs/membership.md)
+            header["repoch"] = self._ring.epoch
+            header["rfp"] = self._ring.current.fingerprint
         obs = self._obs
         if obs is None:
-            return await self._call_retrying(peer, header, body, retries,
-                                             timeout_s)
+            return await self._call_converging(peer, header, body,
+                                               retries, timeout_s)
         op = str(header.get("op"))
         with obs.span(f"rpc.{op}", peer=peer.node_id) as sp:
             # attach INSIDE the span: the rpc span's own id is what the
@@ -303,7 +349,7 @@ class InternalClient:
             acct = {"out": 0, "in": 0}
             failed = True
             try:
-                resp, rbody = await self._call_retrying(
+                resp, rbody = await self._call_converging(
                     peer, header, body, retries, timeout_s, acct)
                 failed = False
                 sp.bytes = acct["out"] + acct["in"]
@@ -313,6 +359,50 @@ class InternalClient:
                     peer.node_id, op, time.perf_counter() - t0,
                     bytes_out=acct["out"], bytes_in=acct["in"],
                     error=failed)
+
+    async def _call_converging(self, peer: PeerAddr, header: dict,
+                               body, retries: int | None,
+                               timeout_s: float | None,
+                               acct: dict | None = None
+                               ) -> tuple[dict, memoryview]:
+        """``_call_retrying`` plus the one-shot epoch-convergence path:
+        a RingEpochMismatch reply means the two sides disagree on
+        membership — the LOWER epoch refreshes (we adopt the peer's
+        newer map straight from the refusal; a stale peer gets ours
+        pushed via ``propose_ring``) and the original call retries
+        exactly once at the converged epoch. A second mismatch (racing
+        epoch bumps) propagates as the application error it is — the
+        caller's normal retry machinery picks it up later."""
+        try:
+            return await self._call_retrying(peer, header, body, retries,
+                                             timeout_s, acct)
+        except RingEpochMismatch as e:
+            ring = self._ring
+            if ring is None:
+                raise
+            ring.note_epoch_mismatch()
+            # the (epoch, fingerprint) total order decides who is
+            # stale: adopt() installs the peer's map iff it beats
+            # ours — otherwise OURS wins and the peer gets it pushed.
+            # Covers racing same-epoch maps, not just lagging epochs.
+            adopted = False
+            if e.ring is not None:
+                try:
+                    adopted = ring.adopt(e.ring,
+                                         source=f"mismatch:"
+                                                f"{peer.node_id}")
+                except ValueError:
+                    raise e from None   # malformed map from the peer
+            if not adopted:
+                # peer's map lost (or was absent): teach it ours
+                await self._call_retrying(
+                    peer, {"op": "propose_ring",
+                           "ring": ring.current.to_dict()},
+                    b"", 1, None, acct)
+            header["repoch"] = ring.epoch
+            header["rfp"] = ring.current.fingerprint
+            return await self._call_retrying(peer, header, body, retries,
+                                             timeout_s, acct)
 
     # decorrelated-jitter backoff bounds (Brooker, "Exponential Backoff
     # And Jitter"): sleep_n = min(CAP, uniform(BASE, 3 * sleep_{n-1})).

@@ -389,6 +389,58 @@ class CensusConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class RingConfig:
+    """Elastic membership plane (dfs_tpu.ring, docs/membership.md).
+
+    EVERYTHING defaults to the legacy behavior: ``vnodes=0`` compiles
+    the boot-time peer list into a STATIC epoch-0 ring whose placement
+    is byte-identical to the pre-r14 cyclic mod-N replica sets —
+    existing stores keep their layout. ``vnodes > 0`` opts into the
+    weighted consistent-hash ring from boot (minimal-movement
+    membership changes); a live membership change (``ring add/remove/
+    drain``) on a static cluster promotes it to hash mode at the
+    default vnode count as part of the epoch bump.
+
+    ``members`` restricts which boot-time peers own digest space at
+    epoch 0 ("" = all of them): extra peers in the cluster config are
+    reachable STANDBY nodes — addressable, announced to, but placed on
+    only after a ``ring add``. This separates addressing (the transport
+    needs it at boot) from membership (the ring changes it live).
+
+    ``rebalance_credit_bytes`` bounds the ONLINE rebalancer: each node
+    streams chunks to their new-epoch owners at most this many payload
+    bytes per second (a token bucket on the repair push path), so a
+    membership change can never starve live traffic of bandwidth.
+    0 = unthrottled.
+    """
+
+    vnodes: int = 0             # vnodes per unit weight; 0 = static
+                                # legacy placement (byte-stable)
+    members: str = ""           # csv node ids owning digest space at
+                                # epoch 0; "" = every cluster peer
+    rebalance_credit_bytes: int = 8 * 1024 * 1024  # rebalance bytes/s
+                                # per node; 0 = unthrottled
+
+    def __post_init__(self) -> None:
+        if self.vnodes < 0:
+            raise ValueError("vnodes must be >= 0")
+        if self.rebalance_credit_bytes < 0:
+            raise ValueError("rebalance_credit_bytes must be >= 0")
+        if not isinstance(self.members, str):
+            raise ValueError("members must be a csv string of node ids")
+        if self.members and not all(
+                p.strip().isdigit() for p in self.members.split(",")):
+            raise ValueError(f"members must be a csv of node ids, "
+                             f"got {self.members!r}")
+
+    def member_ids(self) -> list[int] | None:
+        """Parsed epoch-0 member ids, or None for 'every peer'."""
+        if not self.members:
+            return None
+        return sorted({int(p.strip()) for p in self.members.split(",")})
+
+
+@dataclasses.dataclass(frozen=True)
 class IngestConfig:
     """Pipelined write path (docs/ingest.md) — the knobs bounding how much
     of the three-stage ingest pipeline (fragmentation, local CAS writes,
@@ -479,6 +531,10 @@ class NodeConfig:
     # deterministic fault injection (dfs_tpu.chaos); the default
     # ChaosConfig() builds NO injector — every seam is one None check
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
+    # elastic membership (dfs_tpu.ring): the default RingConfig()
+    # compiles the boot peer list into a static epoch-0 ring whose
+    # placement is byte-identical to the pre-r14 cyclic replica sets
+    ring: RingConfig = dataclasses.field(default_factory=RingConfig)
 
     @property
     def self_addr(self) -> PeerAddr:

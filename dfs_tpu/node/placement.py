@@ -1,61 +1,52 @@
-"""Chunk placement: content-derived cyclic replica sets.
+"""Chunk placement: thin shims over the membership ring (dfs_tpu.ring).
 
-The reference places by *position*: node i holds fragments i and (i+1) mod N
-(StorageNode.java:143-145,199-200) — every node must exist for every upload,
-and placement says nothing about content. Here the replica set is derived from
-the chunk digest itself: the primary is ``int(digest[:16], 16) mod N`` over the
-sorted node list and the remaining replicas follow cyclically, preserving the
-reference's cyclic-×2 redundancy geometry (README.md:65-66) while making
-placement deterministic from content alone — any node can compute, for any
-chunk, exactly who should hold it (no manifest needed for repair).
+Until r14 this module WAS the placement policy — content-derived cyclic
+replica sets over a fixed, boot-time node list (the primary is
+``int(digest[:16], 16) mod N`` and replicas follow cyclically,
+preserving the reference's cyclic-×2 redundancy geometry while making
+placement deterministic from content alone). That math now lives in
+:mod:`dfs_tpu.ring` as the STATIC ring mode (``RingMap.static``), the
+epoch-0 compilation every default-config cluster runs — byte-stable
+with the pre-r14 behavior by construction. These functions remain as
+the list-of-ids convenience surface (tests, benches, standalone tools);
+the node runtime places through its :class:`~dfs_tpu.ring.manager.
+RingManager`, which swaps the static map for a weighted consistent-hash
+ring the moment membership changes live (docs/membership.md).
 """
 
 from __future__ import annotations
 
 from typing import Sequence
 
+from dfs_tpu.ring import (static_ec_shard_node, static_handoff_order,
+                          static_replica_set)
+
 
 def replica_set(digest: str, node_ids: list[int], rf: int) -> list[int]:
-    """Deterministic replica node-ids for a chunk digest. ``node_ids`` must be
-    the same sorted membership list on every node."""
-    if not node_ids:
-        raise ValueError("empty cluster")
-    rf = min(rf, len(node_ids))
-    start = int(digest[:16], 16) % len(node_ids)
-    return [node_ids[(start + j) % len(node_ids)] for j in range(rf)]
+    """Deterministic replica node-ids for a chunk digest over a STATIC
+    membership list (``node_ids`` must be the same sorted list on every
+    node) — the epoch-0 ring's owner set."""
+    return static_replica_set(digest, node_ids, rf)
 
 
 def ec_shard_node(file_id: str, stripe: int, shard: int,
                   node_ids: list[int]) -> int:
-    """Holder of shard ``shard`` (0..k-1 data, k = P, k+1 = Q) of erasure
-    stripe ``stripe``. Digest-derived placement would let two shards of a
-    stripe collide on one node — then a single node loss can exceed the
-    P+Q budget, making EC WORSE than replication. Instead the stripe's
-    base node is derived from (file_id, stripe) and shards fan out
-    consecutively, so all k+2 land on distinct nodes whenever the cluster
-    is big enough (upload enforces k+2 <= N). Computable from the
-    manifest alone — any node can locate any shard for repair, matching
-    replica_set's property for replicated chunks. Different stripes get
-    different bases, spreading load across the cluster."""
-    if not node_ids:
-        raise ValueError("empty cluster")
-    base = (int(file_id[:16], 16) + stripe * 2654435761) % len(node_ids)
-    return node_ids[(base + shard) % len(node_ids)]
+    """Holder of shard ``shard`` (0..k-1 data, k = P, k+1 = Q) of
+    erasure stripe ``stripe`` over a static membership list.
+    Digest-derived placement would let two shards of a stripe collide
+    on one node — then a single node loss can exceed the P+Q budget —
+    so the stripe's base derives from (file_id, stripe) and shards fan
+    out consecutively, all distinct whenever the cluster is big enough
+    (upload enforces k+2 <= N). Computable from the manifest alone."""
+    return static_ec_shard_node(file_id, stripe, shard, node_ids)
 
 
 def handoff_order(pinned: Sequence[int],
                   node_ids: list[int]) -> list[int]:
-    """The agreed candidate order for a PINNED (erasure-coded) shard:
-    its pinned holders, then the membership ring cyclically from the
-    first pinned holder. Upload's sloppy-quorum handoff walks exactly
-    this order when a pinned holder is down (node.runtime.store_all), so
-    the READ side must walk the same order — otherwise a handed-off
-    shard is invisible to candidates_for until a repair pass re-homes
-    it, and every read of it pays the batched-round misses plus the
-    cluster-wide has_chunks sweep."""
-    if not pinned:
-        return list(node_ids)
-    start = node_ids.index(pinned[0]) if pinned[0] in node_ids else 0
-    ring = [node_ids[(start + j) % len(node_ids)]
-            for j in range(len(node_ids))]
-    return list(dict.fromkeys(list(pinned) + ring))
+    """The agreed candidate order for a PINNED (erasure-coded) shard
+    over a static membership list: its pinned holders, then the
+    membership ring cyclically from the first pinned holder. The write
+    side's sloppy-quorum handoff and the read side's candidate walk
+    must agree on this order (see RingMap.handoff_order for the
+    hash-mode generalization)."""
+    return static_handoff_order(pinned, node_ids)

@@ -42,9 +42,9 @@ from dfs_tpu.fragmenter.base import get_fragmenter
 from dfs_tpu.meta.manifest import (ChunkRef, EcInfo, Manifest, StripeRef,
                                    ec_stripe_groups, stripe_shard_len)
 from dfs_tpu.node.health import HealthMonitor
-from dfs_tpu.node.placement import (ec_shard_node, handoff_order,
-                                    replica_set)
 from dfs_tpu.obs import Observability, Span, parse_wire_trace
+from dfs_tpu.ring import RingMap
+from dfs_tpu.ring.manager import RingManager
 from dfs_tpu.serve import BatchPrefetcher, ServingTier
 from dfs_tpu.store.aio import AsyncChunkStore
 from dfs_tpu.store.cas import NodeStore
@@ -83,27 +83,31 @@ class RangeNotSatisfiable(DownloadError):
         self.size = size
 
 
-def ec_placement_map(manifest: Manifest,
-                     node_ids: list[int]) -> Mapping[str, tuple[int, ...]]:
+def ec_placement_map(manifest: Manifest, ring) -> Mapping[str, tuple[int, ...]]:
     """digest -> candidate holder nodes for every shard (data + parity)
-    of an erasure-coded manifest. Derived from the manifest alone
-    (node.placement.ec_shard_node), so any node can locate any shard.
-    A digest appearing in several stripes (dedup within the file) gets
-    the union of its slots' holders. Memoized per (manifest layout,
-    membership): rebuilding measured ~30 ms per gather on a 32 MiB
-    manifest, and a degraded read runs two gathers. The key is a cheap
-    layout fingerprint, not the manifest object — hashing a frozen
-    dataclass walks every ChunkRef, which would cost as much as the
-    rebuild; stripe endpoints pin the ec_k re-upload case where the
-    same file_id maps to a different stripe layout."""
+    of an erasure-coded manifest. Derived from the manifest plus the
+    membership ring alone, so any node can locate any shard. ``ring``
+    is a :class:`~dfs_tpu.ring.RingMap` — or a plain node-id list,
+    which compiles to the static epoch-0 map (the pre-r14 call shape;
+    tests and benches still use it). A digest appearing in several
+    stripes (dedup within the file) gets the union of its slots'
+    holders. Memoized per (manifest layout, ring identity): rebuilding
+    measured ~30 ms per gather on a 32 MiB manifest, and a degraded
+    read runs two gathers. The key is a cheap layout fingerprint, not
+    the manifest object — hashing a frozen dataclass walks every
+    ChunkRef, which would cost as much as the rebuild; stripe endpoints
+    pin the ec_k re-upload case where the same file_id maps to a
+    different stripe layout."""
+    if not isinstance(ring, RingMap):
+        ring = RingMap.static(list(ring))
     ec = manifest.ec
     assert ec is not None
     key = (manifest.file_id, ec.k, len(manifest.chunks), len(ec.stripes),
            ec.stripes[0].p if ec.stripes else "",
-           ec.stripes[-1].q if ec.stripes else "", tuple(node_ids))
+           ec.stripes[-1].q if ec.stripes else "", ring.key)
     hit = _EC_PLACEMENT_CACHE.get(key)
     if hit is None:
-        hit = _ec_placement_build(manifest, list(node_ids))
+        hit = _ec_placement_build(manifest, ring)
         if len(_EC_PLACEMENT_CACHE) >= 64:
             _EC_PLACEMENT_CACHE.pop(next(iter(_EC_PLACEMENT_CACHE)))
         _EC_PLACEMENT_CACHE[key] = hit
@@ -113,20 +117,19 @@ def ec_placement_map(manifest: Manifest,
 _EC_PLACEMENT_CACHE: dict = {}
 
 
-def _ec_placement_build(manifest: Manifest, node_ids: list[int]
+def _ec_placement_build(manifest: Manifest, ring: RingMap
                         ) -> Mapping[str, tuple[int, ...]]:
     ec = manifest.ec
     assert ec is not None
     pl: dict[str, list[int]] = {}
     groups = ec_stripe_groups(manifest.chunks, ec.k)
     for s, (st, grp) in enumerate(zip(ec.stripes, groups)):
+        # one ring walk per stripe: holders for all k data shards + P/Q
+        holders = ring.ec_stripe_nodes(manifest.file_id, s, len(grp) + 2)
         for j, c in enumerate(grp):
-            pl.setdefault(c.digest, []).append(
-                ec_shard_node(manifest.file_id, s, j, node_ids))
-        pl.setdefault(st.p, []).append(
-            ec_shard_node(manifest.file_id, s, len(grp), node_ids))
-        pl.setdefault(st.q, []).append(
-            ec_shard_node(manifest.file_id, s, len(grp) + 1, node_ids))
+            pl.setdefault(c.digest, []).append(holders[j])
+        pl.setdefault(st.p, []).append(holders[len(grp)])
+        pl.setdefault(st.q, []).append(holders[len(grp) + 1])
     # read-only view over tuple values: the map is cached and shared by
     # every reader of this (manifest, membership) pair — a caller
     # mutating it would corrupt placement for all subsequent reads, so
@@ -265,6 +268,15 @@ class StorageNodeServer:
             # worker threads, so ENOSPC/EIO/slow-disk injection covers
             # the AsyncChunkStore tier and every sync caller alike
             self.store.chunks.fault = self.chaos.store_hook()
+        # elastic membership (dfs_tpu.ring, docs/membership.md): the
+        # epoch-versioned placement map + migration window + rebalance
+        # credits. Built after obs (epoch changes journal) and before
+        # the client (placement-bearing RPCs carry the epoch). The
+        # default config compiles a STATIC epoch-0 ring byte-identical
+        # to the pre-r14 cyclic placement.
+        self.ring = RingManager(cfg, self.store.root, obs=self.obs)
+        self.ring.on_change = self._on_ring_change
+        self._repair_lock = asyncio.Lock()
         # async CAS tier: every event-loop chunk put/get routes through a
         # bounded thread pool (store/aio.py) — the loop never blocks on
         # chunk file I/O and disk concurrency is explicit
@@ -288,7 +300,7 @@ class StorageNodeServer:
                                      cfg.request_timeout_s, cfg.retries,
                                      coalesce_fetches=cfg.serve.cache_bytes
                                      > 0, obs=self.obs,
-                                     chaos=self.chaos)
+                                     chaos=self.chaos, ring=self.ring)
         self.health = HealthMonitor(cfg.cluster, cfg.node_id, self.client,
                                     probe_interval_s=cfg.health_probe_s,
                                     obs=self.obs)
@@ -325,6 +337,7 @@ class StorageNodeServer:
                 cfg.census.history_coarse_every,
                 cfg.census.history_coarse_slots)
         self._history_task: asyncio.Task | None = None
+        self._ring_catchup_task: asyncio.Task | None = None
         # last coordinator census summary (doctor snapshot material)
         self._last_census: dict | None = None
         self._disk_pressure = False
@@ -371,6 +384,14 @@ class StorageNodeServer:
         if self.history is not None:
             self._history_task = create_logged_task(
                 self._history_loop(), self.log, "census-history")
+        if self._peers():
+            # membership catch-up: a (re)started node may have slept
+            # through epoch bumps (or lost its ring.json) — one cheap
+            # get_ring round adopts the highest epoch any peer holds,
+            # and a resumed migration picks up where the crash left it.
+            # Best-effort: the epoch-on-RPC gossip is the backstop.
+            self._ring_catchup_task = create_logged_task(
+                self._ring_catchup(), self.log, "ring-catchup")
         # flight-recorder boot record: the config this life ran with is
         # the first question of every post-mortem
         self.obs.event("boot", configHash=self._config_hash,
@@ -383,6 +404,9 @@ class StorageNodeServer:
         if self._history_task is not None:
             self._history_task.cancel()
             self._history_task = None
+        if self._ring_catchup_task is not None:
+            self._ring_catchup_task.cancel()
+            self._ring_catchup_task = None
         if self.sentinel is not None:
             self.sentinel.stop()
         self.health.stop()
@@ -408,6 +432,167 @@ class StorageNodeServer:
     # ------------------------------------------------------------------ #
     # internal storage plane (server side)
     # ------------------------------------------------------------------ #
+
+    # ------------------------------------------------------------------ #
+    # membership plane (dfs_tpu.ring, docs/membership.md)
+    # ------------------------------------------------------------------ #
+
+    def _on_ring_change(self) -> None:
+        """RingManager install hook: kick an immediate rebalance walk
+        (repair_once IS the rebalancer — its manifest walk + bounded
+        pushes now run against the new epoch's owner map) instead of
+        waiting out the periodic repair interval."""
+        try:
+            asyncio.get_running_loop()
+        # absence-as-result: "no running loop" just means this install
+        # happened at boot, before start() — the first periodic repair
+        # cycle runs the same walk
+        except RuntimeError:  # dfslint: ignore[DFS007]
+            return
+        create_logged_task(self._rebalance_kick(), self.log,
+                           "rebalance-kick")
+
+    async def _rebalance_kick(self) -> None:
+        try:
+            await self.repair_once()
+        except Exception as e:  # noqa: BLE001 — next periodic repair
+            # retries; the kick must not die loudly mid-migration
+            self.log.warning("rebalance kick failed: %s", e)
+
+    async def _ring_catchup(self) -> None:
+        best: dict | None = None
+        for peer in self._peers():
+            try:
+                resp, _ = await self.client.call(
+                    peer, {"op": "get_ring"}, retries=1)
+            # not silent: catch-up is best-effort by contract — the
+            # epoch-on-RPC gossip converges a node this round misses
+            except RpcError:  # dfslint: ignore[DFS007]
+                continue
+            ring = resp.get("ring")
+            if isinstance(ring, dict) \
+                    and isinstance(ring.get("epoch"), int) \
+                    and ring["epoch"] > self.ring.epoch \
+                    and (best is None or ring["epoch"] > best["epoch"]):
+                best = ring
+        if best is not None:
+            try:
+                self.ring.adopt(best, source="catchup")
+            except ValueError as e:
+                self.log.warning("ring catch-up rejected peer map: %s", e)
+
+    async def ring_admin(self, action: str, node_id: int | None = None,
+                         weight: float | None = None) -> dict:
+        """Admin membership change (POST /ring): build the epoch+1 map,
+        install locally, push it to every cluster peer (best-effort —
+        a peer that misses the push converges via the epoch-on-RPC
+        gossip), and return the new map + per-peer push results. The
+        rebalancer kicks off via the install hook on every node."""
+        cur = self.ring.current
+        weights = {m.node_id: m.weight for m in cur.members}
+        if action == "add":
+            if node_id is None:
+                raise ValueError("add needs nodeId")
+            if node_id not in {p.node_id for p in self.cfg.cluster.peers}:
+                raise ValueError(
+                    f"node {node_id} is not in the cluster address "
+                    "book (boot every process with it in --nodes/"
+                    "--cluster-config first)")
+            if weights.get(node_id, 0) > 0:
+                raise ValueError(f"node {node_id} is already a ring "
+                                 "member")
+            weights[node_id] = 1.0 if weight is None else float(weight)
+        elif action == "drain":
+            if node_id is None or node_id not in weights:
+                raise ValueError(f"node {node_id} is not a ring member")
+            weights[node_id] = 0.0
+        elif action == "remove":
+            if node_id is None or node_id not in weights:
+                raise ValueError(f"node {node_id} is not a ring member")
+            del weights[node_id]
+            if not weights:
+                raise ValueError("cannot remove the last ring member")
+        elif action == "reweight":
+            if node_id is None or node_id not in weights:
+                raise ValueError(f"node {node_id} is not a ring member")
+            if weight is None:
+                raise ValueError("reweight needs weight")
+            weights[node_id] = float(weight)
+        else:
+            raise ValueError(f"unknown ring action {action!r} "
+                            "(add/drain/remove/reweight)")
+        if not any(w > 0 for w in weights.values()):
+            raise ValueError("change would leave no active member")
+        new = self.ring.propose_next(weights)
+        self.ring.install(new, source=f"admin:{action}")
+        ring_dict = new.to_dict()
+
+        async def push(peer) -> tuple[int, bool]:
+            try:
+                await self.client.call(
+                    peer, {"op": "propose_ring", "ring": ring_dict},
+                    retries=2)
+                return peer.node_id, True
+            # not silent: surfaced per-peer in the admin reply AND the
+            # peer converges later via the epoch-on-RPC gossip
+            except RpcError:  # dfslint: ignore[DFS007]
+                return peer.node_id, False
+
+        pushed = dict(await asyncio.gather(
+            *(push(p) for p in self._peers())))
+        return {"action": action, "epoch": new.epoch,
+                "ring": ring_dict, "pushed": pushed}
+
+    async def ring_status(self, cluster: bool = True) -> dict:
+        """GET /ring: this node's membership view plus (cluster=True)
+        every peer's epoch/migration state — partial on dead peers,
+        like every diagnosis surface."""
+        out = {"nodeId": self.cfg.node_id,
+               "epoch": self.ring.epoch,
+               "mode": "static" if self.ring.current.vnodes == 0
+               else "hash",
+               "vnodes": self.ring.current.vnodes,
+               "members": self.ring.current.to_dict()["members"],
+               "active": self.ring.current.active_ids(),
+               "migrating": self.ring.migrating,
+               "previousEpoch": self.ring.previous.epoch
+               if self.ring.previous is not None else None,
+               "rebalance": self.ring.rebalance_stats()}
+        if not cluster:
+            return out
+
+        async def one(peer) -> tuple[int, dict | None]:
+            try:
+                resp, _ = await self.client.call(
+                    peer, {"op": "get_ring"}, retries=1)
+                ring = resp.get("ring") or {}
+                return peer.node_id, {
+                    "epoch": ring.get("epoch"),
+                    "migrating": bool(resp.get("migrating"))}
+            # not silent: a None row IS the partial-result signal
+            except RpcError:  # dfslint: ignore[DFS007]
+                return peer.node_id, None
+
+        peers = dict(await asyncio.gather(
+            *(one(p) for p in self._peers())))
+        out["peers"] = {str(k): v for k, v in sorted(peers.items())}
+        out["peersFailed"] = sum(1 for v in peers.values() if v is None)
+        return out
+
+    def ring_stats(self) -> dict:
+        """``/metrics`` ``ring`` section. The vnodes/members/
+        rebalanceCreditBytes keys mirror RingConfig fields (dfslint
+        DFS005 checks the config ⇄ CLI ⇄ metrics mapping); the rest is
+        live epoch + rebalance state."""
+        r = self.cfg.ring
+        return {"vnodes": r.vnodes,
+                "members": r.members,
+                "rebalanceCreditBytes": r.rebalance_credit_bytes,
+                "epoch": self.ring.epoch,
+                "mode": "static" if self.ring.current.vnodes == 0
+                else "hash",
+                "active": self.ring.current.active_ids(),
+                "rebalance": self.ring.rebalance_stats()}
 
     async def _serve_internal_frame(self, conn, header: dict,
                                     body: memoryview,
@@ -487,6 +672,46 @@ class StorageNodeServer:
 
     async def _dispatch(self, header: dict, body) -> tuple[dict, object]:
         op = header.get("op")
+        repoch = header.get("repoch")
+        rfp = header.get("rfp")
+        if isinstance(repoch, int) and not isinstance(repoch, bool) \
+                and (repoch != self.ring.epoch
+                     or (isinstance(rfp, str)
+                         and rfp != self.ring.current.fingerprint)):
+            # membership disagreement on a placement-bearing op —
+            # lagging epoch OR a different map at the SAME epoch
+            # (racing admins; the fingerprint tiebreak reconciles):
+            # refuse WITH our epoch + map, so the stale side
+            # (whichever it is) converges and retries instead of
+            # silently mis-placing — see comm/rpc.py
+            # RingEpochMismatch. Ops without the fields (pre-r14
+            # peers, metadata ops) are served as-is.
+            self.ring.note_epoch_mismatch()
+            self.counters.inc("ring_epoch_mismatches")
+            return {"ok": False,
+                    "error": f"ring epoch mismatch (have "
+                             f"{self.ring.epoch}, got {repoch})",
+                    "ringEpoch": self.ring.epoch,
+                    "ring": self.ring.current.to_dict()}, b""
+        if op == "get_ring":
+            # membership query (ring status / boot catch-up): cheap
+            # metadata, ungated like health
+            return {"ok": True, "ring": self.ring.current.to_dict(),
+                    "previous": self.ring.previous.to_dict()
+                    if self.ring.previous is not None else None,
+                    "migrating": self.ring.migrating}, b""
+        if op == "propose_ring":
+            # epoch-versioned membership install (admin push / the
+            # stale-peer refresh path). Idempotent: at-or-below-epoch
+            # proposals answer ok with our state — gossip is
+            # at-least-once.
+            try:
+                installed = self.ring.adopt(header.get("ring"),
+                                            source="propose")
+            except ValueError as e:
+                return {"ok": False, "error": f"bad ring map: {e}"}, b""
+            return {"ok": True, "epoch": self.ring.epoch,
+                    "installed": installed}, b""
         if op == "store_chunks":
             # Hash echo: recompute every digest from the received bytes
             # (reference receiver contract, StorageNode.java:279-292).
@@ -655,12 +880,12 @@ class StorageNodeServer:
         placement = None
         rf = None
         if ec_k:
-            ids = self.cfg.cluster.sorted_ids()
+            ids = self.ring.node_ids()
             if ec_k + 2 > len(ids):
                 raise UploadError(
-                    f"ec={ec_k} needs {ec_k + 2} nodes, cluster has "
-                    f"{len(ids)} (shards of a stripe must land on "
-                    "distinct nodes)", status=400)
+                    f"ec={ec_k} needs {ec_k + 2} nodes, ring has "
+                    f"{len(ids)} active (shards of a stripe must land "
+                    "on distinct nodes)", status=400)
             if ec_k > 255:
                 # the Q coefficients live in GF(256)*'s order-255 group:
                 # beyond k=255 they repeat and some double erasures
@@ -677,7 +902,7 @@ class StorageNodeServer:
                     seen.add(d)
                     batch.append((d, b))
             stats["ecParityBytes"] = sum(len(b) for _, b in parity)
-            placement = ec_placement_map(manifest, ids)
+            placement = ec_placement_map(manifest, self.ring.current)
             rf = 1   # the parity IS the redundancy (any 2 shards may die)
         await self._place_batch(file_id, batch, stats, rf=rf,
                                 placement=placement)
@@ -969,12 +1194,13 @@ class StorageNodeServer:
                    if is_hex_digest(d) and not self.store.chunks.has(d)]
         if not missing:
             return []
-        ids = self.cfg.cluster.sorted_ids()
         rf = self.cfg.cluster.replication_factor
         found: set[str] = set()
         by_peer: dict[int, list[str]] = {}
         for d in missing:
-            for t in replica_set(d, ids, rf):
+            # dual-read candidates: mid-rebalance the bytes may still
+            # sit at previous-epoch owners only
+            for t in self.ring.read_candidates(d, rf):
                 if t != self.cfg.node_id:
                     by_peer.setdefault(t, []).append(d)
 
@@ -1157,20 +1383,25 @@ class StorageNodeServer:
         handoff ring then continues cyclically from the pinned holder."""
         if self.chaos is not None:
             self.chaos.maybe_crash("place.before_local_put")
-        ids = self.cfg.cluster.sorted_ids()
+        # placement snapshot: ONE ring map for the whole batch — a
+        # concurrent epoch adoption must not split a batch between two
+        # maps (the rebalancer reconciles whole batches placed under
+        # either epoch; a half-and-half batch would satisfy neither)
+        ring = self.ring.current
+        ids = ring.active_ids()
         if rf is None:
             rf = self.cfg.cluster.replication_factor
         placement = placement or {}
 
         def primary_targets(digest: str) -> Sequence[int]:
             return placement.get(digest) \
-                or replica_set(digest, ids, rf)
+                or ring.owners(digest, rf)
 
         def handoff_ring(digest: str) -> list[int]:
             pinned = placement.get(digest)
             if not pinned:
-                return replica_set(digest, ids, len(ids))
-            return handoff_order(pinned, ids)
+                return ring.owners(digest, len(ids))
+            return ring.handoff_order(pinned)
 
         per_node: dict[int, list[tuple[str, bytes]]] = {}
         copies: dict[str, int] = {}
@@ -1429,19 +1660,23 @@ class StorageNodeServer:
         data = await self.cas.get(digest)
         if data is not None:
             return data
-        ids = self.cfg.cluster.sorted_ids()
         rf = self.cfg.cluster.replication_factor
-        candidates = [t for t in replica_set(digest, ids, rf)
+        # current-epoch owners first, then previous-epoch owners (the
+        # dual-read migration window: mid-rebalance the bytes may not
+        # have reached their new home yet — docs/membership.md)
+        candidates = [t for t in self.ring.read_candidates(digest, rf)
                       if t != self.cfg.node_id]
         # try believed-alive replicas first; dead ones remain as last resort
         candidates.sort(key=lambda t: not self.health.is_alive(t))
-        # then every OTHER peer (alive-first too): after a membership
-        # change the mod-N replica set remaps, but the bytes still live
-        # on the old holders until repair migrates them (see README on
-        # rebalance) — and a known-dead peer ahead of a live holder
-        # would cost a connect timeout per chunk
+        # then every OTHER peer in the ADDRESS BOOK (alive-first too),
+        # not just active ring members: handoff copies and stale
+        # placement can park bytes on a node that has since been
+        # drained (weight 0) or removed from the ring — it is still
+        # reachable and may hold the only surviving copy. A known-dead
+        # peer ahead of a live holder would cost a connect timeout per
+        # chunk, hence the alive-first sort.
         candidates += sorted(
-            (t for t in ids
+            (t for t in self.cfg.cluster.sorted_ids()
              if t != self.cfg.node_id and t not in candidates),
             key=lambda t: not self.health.is_alive(t))
         for target in candidates:
@@ -1462,6 +1697,10 @@ class StorageNodeServer:
             # (stronger than the reference, which only checks the whole file).
             if len(data) == length and sha256_hex(data) == digest:
                 self.counters.inc("chunks_fetched_remote")
+                if self.ring.is_prev_only(digest, target, rf):
+                    # served through the dual-read window: the byte
+                    # came from a previous-epoch owner mid-move
+                    self.ring.note_dual_read_hit()
                 return data
             self.log.warning("corrupt chunk %s from node %d",
                              digest[:12], target)
@@ -1503,24 +1742,33 @@ class StorageNodeServer:
         if not need:
             return out
 
-        ids = self.cfg.cluster.sorted_ids()
+        ring = self.ring
         rf = self.cfg.cluster.replication_factor
         # EC manifests pin shards to stripe-derived holders, not the
         # digest ring — group fetches by the real holder or every round
         # asks the wrong peers and falls through to the slow has_chunks
-        # sweep
-        pref = ec_placement_map(manifest, ids) \
+        # sweep. Mid-migration the PREVIOUS epoch's pinned holders join
+        # the candidate walk (dual-read window).
+        pref = ec_placement_map(manifest, ring.current) \
             if manifest is not None and manifest.ec is not None else {}
+        pref_prev = ec_placement_map(manifest, ring.previous) \
+            if pref and ring.previous is not None else {}
 
         def candidates_for(d: str) -> Sequence[int]:
             pinned = pref.get(d)
             if pinned:
-                # pinned + the cyclic handoff continuation: a shard that
+                # pinned + the handoff continuation: a shard that
                 # sloppy-quorum handoff placed on a non-pinned node is
                 # findable by the batched rounds (the write side walked
                 # this same order), not only by the cluster-wide sweep
-                return handoff_order(pinned, ids)
-            return replica_set(d, ids, rf)
+                out = ring.handoff_order(pinned)
+                prev_pin = pref_prev.get(d)
+                if prev_pin:
+                    out = list(dict.fromkeys(
+                        list(out) + list(prev_pin)))
+                return out
+            # current owners + previous-epoch owners (dual-read window)
+            return ring.read_candidates(d, rf)
 
         def group_remaining(exclude: set[int]) -> dict[int, list[str]]:
             """Missing digests grouped by their first believed-alive
@@ -1582,6 +1830,9 @@ class StorageNodeServer:
                                 and len(b) == need[d]):
                             out[d] = b
                             self.counters.inc("chunks_fetched_remote")
+                            if ring.migrating and ring.is_prev_only(
+                                    d, node_id, rf):
+                                ring.note_dual_read_hit()
                 batch, size = [], 0
 
             for d in digests:
@@ -2252,6 +2503,14 @@ class StorageNodeServer:
             # this node coordinated — feeds the underreplication rule
             "capacity": self._capacity_summary(),
             "census": self._last_census,
+            # membership view: epoch + migration progress — the
+            # doctor's epoch_mismatch and rebalance_stuck evidence
+            "ring": {"epoch": self.ring.epoch,
+                     "migrating": self.ring.migrating,
+                     **{k: v for k, v in
+                        self.ring.rebalance_stats().items()
+                        if k in ("sinceProgressS", "bytesMoved",
+                                 "dualReadHits")}},
         }
 
     async def doctor_report(self, cluster: bool = True) -> dict:
@@ -2442,11 +2701,18 @@ class StorageNodeServer:
         active trace id."""
         from dfs_tpu.obs import census as census_mod
 
-        ids = self.cfg.cluster.sorted_ids()
         rf = self.cfg.cluster.replication_factor
+        # epoch-aware expectation: bucket tables derive from the ring's
+        # owner map; mid-migration the PREVIOUS epoch's owners join the
+        # union expectation so a rebalance in flight reads as IN-FLIGHT
+        # digests, not thousands of phantom under-/over-replication
+        # findings (docs/membership.md)
+        cur_ring = self.ring.current
+        prev_ring = self.ring.previous
         manifests = await asyncio.to_thread(self.store.manifests.list)
-        expected, lengths, logical = await asyncio.to_thread(
-            census_mod.expected_state, manifests, ids, rf)
+        expected, cur_expected, lengths, logical = \
+            await asyncio.to_thread(census_mod.expected_state_ring,
+                                    manifests, cur_ring, prev_ring, rf)
         peers = self._peers() if cluster else []
         inventories: dict[int, dict | None] = {
             self.cfg.node_id: await self.census_inventory()}
@@ -2499,7 +2765,9 @@ class StorageNodeServer:
 
         report = await asyncio.to_thread(
             census_mod.build_report, expected, lengths, inventories,
-            drilled, self.cfg.census.max_listed)
+            drilled, self.cfg.census.max_listed, cur_expected)
+        report["ringEpoch"] = cur_ring.epoch
+        report["migrating"] = prev_ring is not None
 
         # capacity / df section: per-node and cluster byte accounting
         nodes_cap: dict[str, dict | None] = {}
@@ -2719,24 +2987,68 @@ class StorageNodeServer:
         return adopted
 
     async def repair_once(self) -> int:
-        """Re-replicate chunks below replication factor. Walks every local
-        manifest; for chunks whose replica set includes peers missing the
-        bytes, pushes from a local or remote copy. Returns #chunks repaired.
+        """Re-replicate chunks below replication factor — and, since
+        r14, the ONLINE REBALANCER: after a ring epoch change the same
+        manifest walk computes placement against the NEW owner map, so
+        chunks stream to their new-epoch owners through the bounded
+        async CAS tier + sliced pushes, under the ring's byte credits
+        (``RingConfig.rebalance_credit_bytes``), with exactly one
+        DESIGNATED mover per digest (the first alive previous-epoch
+        owner) so a membership change moves each byte once, not once
+        per node. When a full walk confirms every digest at its
+        new-epoch owners, the migration window closes
+        (``rebalance_done``) and reads stop consulting the previous
+        map. Returns #chunks repaired/moved.
 
         Tombstone anti-entropy runs FIRST: repairing from a manifest whose
         file was deleted cluster-wide while this node slept would push the
         deleted chunks back onto peers. Manifest anti-entropy runs second
         (adopt creates this node missed), so the repair walk below also
         restores this node's canonical chunks for newly-adopted files."""
+        async with self._repair_lock:
+            # serialized: the periodic repair loop and the install-time
+            # rebalance kick must not interleave two walks (their
+            # confirmed-sets would cross-talk into a bogus
+            # finish_migration)
+            return await self._repair_once_locked()
+
+    async def _repair_once_locked(self) -> int:
         await self._tombstone_antientropy()
         await self._manifest_antientropy()
-        ids = self.cfg.cluster.sorted_ids()
+        # placement snapshot for the WHOLE walk: epoch adoptions landing
+        # mid-walk take effect next cycle (and block finish_migration
+        # below — the identity check), never mid-computation
+        cur = self.ring.current
+        prev = self.ring.previous
+        migrating = prev is not None
         rf = self.cfg.cluster.replication_factor
         need: dict[int, list[tuple[str, int]]] = {}
         chunk_len: dict[str, int] = {}
         own_missing: dict[str, int] = {}
         own_missing_ec: list[tuple[Manifest, list[ChunkRef]]] = []
         ec_digests: set[str] = set()
+        # previous-epoch holders of EC shards (designated-mover order);
+        # replicated digests compute theirs on demand (one ring walk)
+        prev_ec_holders: dict[str, tuple[int, ...]] = {}
+
+        def designated_mover(d: str) -> bool:
+            """During a migration exactly ONE node streams a digest to
+            its new owners: the first ALIVE previous-epoch holder (a
+            dead mover's duty falls to the next; a digest no previous
+            owner survives for is pushed best-effort by whoever holds
+            a copy). Outside a migration every node pushes — the
+            pre-r14 repair behavior."""
+            if not migrating:
+                return True
+            holders = prev_ec_holders.get(d)
+            if holders is None:
+                holders = prev.owners(d, rf)
+            for p in holders:
+                if p == self.cfg.node_id:
+                    return True
+                if self.health.is_alive(p):
+                    return False
+            return True
         # One readdir snapshot of the local catalog, off the loop. It
         # serves BOTH sides of the walk below: the own-missing checks
         # (which previously paid a stat() per canonical digest) and the
@@ -2755,11 +3067,15 @@ class StorageNodeServer:
                 # each; a holder missing its shard regenerates it LOCALLY
                 # via parity decode (the push loop below only relocates
                 # surviving copies — it cannot invent lost bytes)
-                pl = ec_placement_map(m, ids)
+                pl = ec_placement_map(m, cur)
+                pl_prev = ec_placement_map(m, prev) if migrating else {}
                 miss: dict[str, int] = {}
                 for d, ln in ec_shard_items(m):
                     chunk_len[d] = ln
                     ec_digests.add(d)
+                    if migrating:
+                        prev_ec_holders.setdefault(
+                            d, tuple(pl_prev.get(d, ())))
                     for target in pl[d]:
                         if target != self.cfg.node_id:
                             need.setdefault(target, []).append((d, ln))
@@ -2773,7 +3089,7 @@ class StorageNodeServer:
                 continue
             for c in m.chunks:
                 chunk_len[c.digest] = c.length
-                targets = replica_set(c.digest, ids, rf)
+                targets = cur.owners(c.digest, rf)
                 for target in targets:
                     if target != self.cfg.node_id:
                         need.setdefault(target, []).append(
@@ -2809,18 +3125,63 @@ class StorageNodeServer:
                 self.counters.inc("bytes_stored", nbytes)
             return len(items)
 
+        own_restored = True   # did every own-copy restore succeed?
+
+        async def restore_missing(manifest: Manifest | None,
+                                  refs: list[ChunkRef]
+                                  ) -> tuple[int, bool]:
+            """Pull this node's missing canonical copies in BOUNDED
+            (~_FETCH_BATCH_BYTES) batches: memory stays one batch no
+            matter the catalog size, and during a migration each batch
+            is charged against the rebalance byte credits AND counted
+            into bytesMoved — the JOINING node's pull is the dominant
+            transfer of a `ring add` (every node already holds every
+            manifest, so the new owner pulls its whole share), and an
+            unmetered pull would void both the bandwidth bound and the
+            moved-bytes accounting the r14 artifact gates. Progress
+            also feeds the doctor's rebalance_stuck gauge."""
+            n = 0
+            ok = True
+            batch: list[ChunkRef] = []
+            size = 0
+
+            async def flush() -> None:
+                nonlocal n, ok, batch, size
+                if not batch:
+                    return
+                if migrating:
+                    self.ring.note_credit_stall(
+                        await self.ring.credits.acquire(size))
+                got = await self._gather_chunks(manifest, chunks=batch,
+                                                strict=False)
+                n += await restore_local(got)
+                ok = ok and {r.digest for r in batch} <= set(got)
+                if migrating and got:
+                    self.ring.note_moved(
+                        sum(len(b) for b in got.values()), pushes=0)
+                batch, size = [], 0
+
+            for r in refs:
+                batch.append(r)
+                size += r.length
+                if size >= self._FETCH_BATCH_BYTES:
+                    await flush()
+            await flush()
+            return n, ok
+
         if own_missing:
             refs = [ChunkRef(index=0, offset=0, length=ln, digest=d)
                     for d, ln in own_missing.items()]
-            got = await self._gather_chunks(None, chunks=refs,
-                                            strict=False)
-            repaired += await restore_local(got)
+            n_restored, ok = await restore_missing(None, refs)
+            repaired += n_restored
+            own_restored = ok
         # EC shards this node should hold: gather WITH the manifest so
         # the parity-decode fallback can rebuild bytes that survive
         # nowhere (a replicated chunk in that state is simply gone)
         for m, refs in own_missing_ec:
-            got = await self._gather_chunks(m, chunks=refs, strict=False)
-            repaired += await restore_local(got)
+            n_restored, ok = await restore_missing(m, refs)
+            repaired += n_restored
+            own_restored = own_restored and ok
         verified: set[str] = set()
         # digest -> canonical holders CONFIRMED to hold it this cycle
         # (has_chunks answer or push hash-echo) — the relocation pass
@@ -2838,6 +3199,13 @@ class StorageNodeServer:
                 for d in have:
                     confirmed.setdefault(d, set()).add(node_id)
                 to_push = sorted(set(digests) - have)
+                if migrating:
+                    # one designated mover per digest: a membership
+                    # change must move each byte ONCE across the
+                    # cluster, not once per node walking its manifests
+                    # (the moved-bytes-vs-theoretical-minimum gate of
+                    # REBALANCE_r14.json)
+                    to_push = [d for d in to_push if designated_mover(d)]
                 # local reads ride the bounded CAS pool (one job for the
                 # batch, off the loop) like every other chunk-file touch
                 local = dict(await self.cas.get_many(to_push))
@@ -2870,6 +3238,15 @@ class StorageNodeServer:
                     # ingest for per-peer bandwidth.
                     for part in self._slice_payloads(
                             payload, self._REPLICA_SLICE_BYTES):
+                        if migrating:
+                            # rebalance byte credits: migration pushes
+                            # are rate-bounded per node so a membership
+                            # change can never starve live traffic
+                            # (stall time is metered — /metrics
+                            # ring.rebalance.creditStallS)
+                            stalled = await self.ring.credits.acquire(
+                                sum(len(b) for _, b in part))
+                            self.ring.note_credit_stall(stalled)
                         echoed = set(await self.client.store_chunks(
                             peer, "", part))
                         ok = {d for d, _ in part} & echoed
@@ -2877,6 +3254,10 @@ class StorageNodeServer:
                         verified |= ok
                         for d in ok:
                             confirmed.setdefault(d, set()).add(node_id)
+                        if migrating and ok:
+                            self.ring.note_moved(
+                                sum(len(b) for d, b in part if d in ok),
+                                pushes=1)
             except RpcError as e:
                 # journaled (DFS007): the chunks stay in
                 # under_replicated and next cycle retries, but a repair
@@ -2911,6 +3292,23 @@ class StorageNodeServer:
             if relocated:
                 self.serve.drop_cached(relocated)
                 self.counters.inc("relocated_chunks", len(relocated))
+        # migration completion: this walk probed EVERY current-epoch
+        # owner of EVERY digest this node's manifests reference (the
+        # `need` map) — if each one confirmed its copy (has_chunks
+        # answer or push hash-echo) and our own copies are whole, the
+        # data has fully reached its new-epoch homes and the dual-read
+        # window can close. The identity checks gate racing epoch
+        # bumps: a map adopted mid-walk means these confirmations
+        # were computed against a stale expectation — next cycle
+        # re-judges.
+        if migrating and self.ring.current is cur \
+                and self.ring.previous is prev:
+            complete = own_restored and all(
+                all(node_id in confirmed.get(d, ())
+                    for d, _ in wanted)
+                for node_id, wanted in need.items())
+            if complete:
+                self.ring.finish_migration()
         # aged orphan sweep: chunks of aborted streaming uploads (placed
         # before their manifest existed, then never committed) have no
         # other reclamation path; the 1h grace keeps in-flight uploads

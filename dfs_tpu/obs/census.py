@@ -60,42 +60,75 @@ def _prefix(digest: str) -> str:
 def expected_state(manifests: Sequence, ids: list[int], rf: int
                    ) -> tuple[dict[str, tuple[int, ...]], dict[str, int],
                               int]:
-    """Walk manifests into the census expectation: ``digest -> expected
-    holder node ids`` (replica set, or EC stripe-pinned holders),
-    ``digest -> byte length``, and the logical byte total (sum of
-    manifest sizes — the numerator of the dedup ratio). Pure CPU: run
-    via ``asyncio.to_thread`` from the node runtime."""
+    """Walk manifests into the census expectation over a STATIC
+    membership list: ``digest -> expected holder node ids`` (replica
+    set, or EC stripe-pinned holders), ``digest -> byte length``, and
+    the logical byte total (sum of manifest sizes — the numerator of
+    the dedup ratio). Pure CPU: run via ``asyncio.to_thread``. The
+    epoch-aware runtime path is :func:`expected_state_ring`; this is
+    its fixed-membership shape (tests, benches)."""
+    from dfs_tpu.ring import RingMap
+
+    union, _cur, lengths, logical = expected_state_ring(
+        manifests, RingMap.static(ids), None, rf)
+    return union, lengths, logical
+
+
+def expected_state_ring(manifests: Sequence, ring, prev_ring, rf: int
+                        ) -> tuple[dict[str, tuple[int, ...]],
+                                   dict[str, tuple[int, ...]],
+                                   dict[str, int], int]:
+    """Epoch-aware census expectation (docs/membership.md): walk
+    manifests against the ring's owner map. Returns ``(expected_union,
+    expected_current, lengths, logical)`` where ``expected_current``
+    maps each digest to its CURRENT-epoch owners (the replication
+    target the under-check judges against) and ``expected_union`` adds
+    the PREVIOUS epoch's owners while a migration window is open —
+    copies still sitting at their old home are EXPECTED there
+    mid-move, so one rebalance cannot light up thousands of phantom
+    under-/over-replication or orphan findings. With ``prev_ring``
+    None the two maps are the same object."""
     # EC placement reuses the runtime's memoized stripe->holder map;
     # imported lazily because the runtime imports this module back
-    from dfs_tpu.node.placement import replica_set
     from dfs_tpu.node.runtime import ec_placement_map, ec_shard_items
 
-    expected: dict[str, tuple[int, ...]] = {}
+    union: dict[str, tuple[int, ...]] = {}
+    current: dict[str, tuple[int, ...]] = union if prev_ring is None \
+        else {}
     lengths: dict[str, int] = {}
     logical = 0
 
-    def add(d: str, holders) -> None:
+    def add(table: dict, d: str, holders) -> None:
         # UNION across manifests: a digest deduped between two files
         # with different placements (two EC stripes, or EC + replica)
         # legitimately lives at both — the write path probes and fills
         # EACH file's targets, so overwriting one expectation with the
         # other would read the real extra copies as over-replicated
-        cur = expected.get(d)
-        expected[d] = tuple(sorted(set(cur) | set(holders))) \
+        cur = table.get(d)
+        table[d] = tuple(sorted(set(cur) | set(holders))) \
             if cur else tuple(sorted(holders))
 
     for m in manifests:
         logical += m.size
         if m.ec is not None:
-            pl = ec_placement_map(m, ids)
+            pl = ec_placement_map(m, ring)
+            pl_prev = ec_placement_map(m, prev_ring) \
+                if prev_ring is not None else None
             for d, ln in ec_shard_items(m):
                 lengths.setdefault(d, ln)
-                add(d, pl[d])
+                add(current, d, pl[d])
+                if pl_prev is not None:
+                    add(union, d, tuple(pl[d]) + tuple(
+                        pl_prev.get(d, ())))
             continue
         for c in m.chunks:
             lengths.setdefault(c.digest, c.length)
-            add(c.digest, replica_set(c.digest, ids, rf))
-    return expected, lengths, logical
+            owners = ring.owners(c.digest, rf)
+            add(current, c.digest, owners)
+            if prev_ring is not None:
+                add(union, c.digest,
+                    owners + prev_ring.owners(c.digest, rf))
+    return union, current, lengths, logical
 
 
 def summarize_expected(expected: Mapping[str, tuple[int, ...]],
@@ -142,14 +175,25 @@ def build_report(expected: Mapping[str, tuple[int, ...]],
                  lengths: Mapping[str, int],
                  inventories: Mapping[int, dict | None],
                  drilled: Mapping[int, Mapping[str, Sequence[str]]],
-                 max_listed: int) -> dict:
+                 max_listed: int,
+                 cur_expected: Mapping[str, tuple[int, ...]]
+                 | None = None) -> dict:
     """Cross-reference expectation against observed inventories into
     the census findings. ``inventories[nid] is None`` = the peer did
     not answer (its expected copies count as *unknown*, not missing).
     ``drilled[nid][prefix]`` is the actual digest list for a bucket
     whose summary mismatched; buckets with MATCHING summaries are taken
     as holding exactly their expected members (that is what the
-    count+hash equality certifies)."""
+    count+hash equality certifies).
+
+    Mid-migration (``cur_expected`` differing from ``expected``):
+    ``expected`` is the union of current- and previous-epoch owners (a
+    copy still at its old home is expected there, not an orphan or an
+    extra), while the under-replication bar is the CURRENT epoch's
+    owner count — digests whose copy count sits between the two maps
+    are IN-FLIGHT (``inFlightTotal``), not findings."""
+    if cur_expected is None:
+        cur_expected = expected
     exp_by_node = summarize_expected(expected, lengths)
     # per-node per-prefix expected membership, built ONCE (the naive
     # walk-all-digests-per-bucket comparison is quadratic in catalog
@@ -212,9 +256,10 @@ def build_report(expected: Mapping[str, tuple[int, ...]],
     histogram: dict[str, int] = {}
     under: list[dict] = []
     over: list[dict] = []
-    n_under = n_over = 0
+    n_under = n_over = n_inflight = 0
     for d in sorted(expected):
-        want = len(expected[d])
+        want = len(cur_expected.get(d, expected[d]))   # current-epoch bar
+        cap = len(expected[d])                          # union cap
         have = observed[d]
         histogram[str(have)] = histogram.get(str(have), 0) + 1
         # unknown copies (dead peers, undrilled buckets) count toward
@@ -225,13 +270,19 @@ def build_report(expected: Mapping[str, tuple[int, ...]],
             if len(under) < max_listed:
                 under.append({"digest": d, "expected": want,
                               "observed": have,
-                              "holders": list(expected[d])})
-        elif have > want:
+                              "holders":
+                              list(cur_expected.get(d, expected[d]))})
+        elif have > cap:
             n_over += 1
             if len(over) < max_listed:
-                over.append({"digest": d, "expected": want,
+                over.append({"digest": d, "expected": cap,
                              "observed": have,
                              "extraOn": sorted(over_holders.get(d, []))})
+        elif cap != want and have != want:
+            # migration pending for this digest: enough copies exist
+            # (old + new homes), placement just hasn't converged —
+            # a rebalance in flight, not a data-health finding
+            n_inflight += 1
     orphan_list = [{"digest": d, "nodes": sorted(ns)}
                    for d, ns in sorted(orphans.items())][:max_listed]
     return {
@@ -240,6 +291,7 @@ def build_report(expected: Mapping[str, tuple[int, ...]],
         "underReplicated": under, "underReplicatedTotal": n_under,
         "orphaned": orphan_list, "orphanedTotal": len(orphans),
         "overReplicated": over, "overReplicatedTotal": n_over,
+        "inFlightTotal": n_inflight,
         "uncheckedBuckets": unchecked,
     }
 
@@ -276,6 +328,10 @@ def render_census(report: dict) -> str:
                          + (f"observed {f['observed']}/{f['expected']} "
                             if "observed" in f else "")
                          + f"nodes {where}")
+    if report.get("inFlightTotal"):
+        lines.append(f"  {report['inFlightTotal']} digest(s) in flight "
+                     f"(rebalance to ring epoch "
+                     f"{report.get('ringEpoch', '?')} in progress)")
     if report.get("uncheckedBuckets"):
         lines.append(f"  ({report['uncheckedBuckets']} diverging "
                      "bucket(s) beyond the drill cap left unchecked)")
@@ -310,5 +366,5 @@ def render_df(report: dict) -> str:
 
 
 __all__ = ["DRILL_BUCKET_CAP", "build_report", "diff_buckets",
-           "expected_state", "render_census", "render_df",
-           "summarize_expected"]
+           "expected_state", "expected_state_ring", "render_census",
+           "render_df", "summarize_expected"]

@@ -60,6 +60,18 @@ Rules (each produces ``{"rule", "severity", "peers", "evidence"}``):
                        findings (``census.underReplicated``). Durability
                        is the one promise this system makes; this rule
                        is the loudest one in the table.
+- ``epoch_mismatch`` — nodes report different ring epochs (r14): a
+                       membership change did not reach everyone — the
+                       epoch-on-RPC gossip converges the stale side on
+                       first contact, but a persistently split epoch
+                       view means a partitioned/firewalled node placing
+                       by an old map.
+- ``rebalance_stuck`` — a node has been migrating to a new ring epoch
+                       with no movement progress for
+                       ``REBALANCE_STUCK_S`` (its ``sinceProgressS``
+                       gauge): a dead new owner, exhausted credits, or
+                       a wedged repair loop — see its /events journal
+                       for the last ``rebalance_start``.
 
 Thresholds live here as module constants, documented in
 docs/observability.md; the bench's injected-slow-peer scenario
@@ -76,6 +88,10 @@ CACHE_HIT_FLOOR = 0.5
 CREDIT_STALL_MIN_S = 1.0
 CAPACITY_ETA_WARN_S = 24 * 3600.0   # disk full within a day: warning
 CAPACITY_ETA_CRIT_S = 3600.0        # within the hour: critical
+REBALANCE_STUCK_S = 120.0  # migrating with no progress this long =
+                        # rebalance_stuck (a healthy rebalance makes
+                        # progress every repair cycle; credits stretch
+                        # a cycle, they do not zero its progress)
 CENSUS_STALE_S = 900.0  # census findings older than this stop firing
                         # the underreplication rule: the census is
                         # pull-only, so a days-old snapshot must not
@@ -350,9 +366,48 @@ def diagnose(snapshots: dict[int, dict | None],
                                 f"replication factor (repair queue "
                                 f"{queue}; last census {seen})"})
 
+    def epoch_mismatch() -> None:
+        # membership convergence: every node should place by the same
+        # ring epoch. The gossip heals transient splits on first
+        # contact, so a mismatch that survives long enough to be SEEN
+        # by a doctor query is worth a name.
+        epochs: dict[int, list[int]] = {}
+        for nid, snap in sorted(live.items()):
+            e = (snap.get("ring") or {}).get("epoch")
+            if isinstance(e, int) and not isinstance(e, bool):
+                epochs.setdefault(e, []).append(nid)
+        if len(epochs) > 1:
+            groups = "; ".join(f"epoch {e}: nodes {nids}"
+                               for e, nids in sorted(epochs.items()))
+            stale = [n for e, ns in sorted(epochs.items())[:-1]
+                     for n in ns]
+            findings.append({"rule": "epoch_mismatch",
+                             "severity": "warning", "peers": stale,
+                             "evidence": "split ring epoch view — "
+                                         f"{groups} (stale nodes place "
+                                         "by an old owner map)"})
+
+    def rebalance_stuck() -> None:
+        for nid, snap in sorted(live.items()):
+            ring = snap.get("ring") or {}
+            since = ring.get("sinceProgressS")
+            if ring.get("migrating") \
+                    and isinstance(since, (int, float)) \
+                    and since >= REBALANCE_STUCK_S:
+                findings.append({
+                    "rule": "rebalance_stuck", "severity": "warning",
+                    "peers": [nid],
+                    "evidence": f"migrating to ring epoch "
+                                f"{ring.get('epoch', '?')} with no "
+                                f"movement progress for {since:.0f}s "
+                                f"({ring.get('bytesMoved', 0)} bytes "
+                                "moved so far — see its /events "
+                                "journal)"})
+
     for rule in (dead_peer, slow_peer, shed_storm, credit_starvation,
                  cache_thrash, clock_skew, config_drift, loop_lag,
-                 capacity_trend, underreplication):
+                 capacity_trend, underreplication, epoch_mismatch,
+                 rebalance_stuck):
         try:
             rule()
         except Exception as e:   # noqa: BLE001 — see docstring

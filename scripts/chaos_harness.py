@@ -238,6 +238,48 @@ class ClusterHarness:
     def metrics(self, node_id: int) -> dict:
         return self.get_json(node_id, "/metrics")
 
+    # ---- membership ring (docs/membership.md) ------------------------ #
+
+    def ring_status(self, node_id: int, cluster: bool = False) -> dict:
+        return self.get_json(
+            node_id, f"/ring?cluster={'1' if cluster else '0'}")
+
+    def ring_post(self, node_id: int, **body) -> dict:
+        """POST /ring membership change on one node (it pushes the new
+        epoch cluster-wide and kicks the rebalancer)."""
+        status, resp = self.http(
+            node_id, "POST", "/ring", body=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, timeout=60)
+        if status != 200:
+            raise HarnessError(f"POST /ring on node {node_id} -> "
+                               f"{status}: {resp[:200]!r}")
+        return json.loads(resp)
+
+    def wait_ring_converged(self, epoch: int, node_ids=None,
+                            timeout: float = 90.0) -> None:
+        """Block until every named node reports the epoch AND has
+        closed its migration window (rebalance_done) — the moment
+        dual-read ends and placement is steady-state again."""
+        deadline = time.time() + timeout
+        pending = list(node_ids or range(1, self.n + 1))
+        while pending and time.time() < deadline:
+            still = []
+            for i in pending:
+                try:
+                    st = self.ring_status(i)
+                    if st.get("epoch") != epoch or st.get("migrating"):
+                        still.append(i)
+                except (OSError, HarnessError):
+                    still.append(i)
+            pending = still
+            if pending:
+                time.sleep(0.5)
+        if pending:
+            raise HarnessError(
+                f"nodes {pending} never converged to ring epoch "
+                f"{epoch} within {timeout}s: "
+                + "; ".join(self.node_log(i)[-500:] for i in pending))
+
     def census(self, node_id: int) -> dict:
         return self.get_json(node_id, "/census", timeout=120)
 

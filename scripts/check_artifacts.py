@@ -36,7 +36,8 @@ CODE_SUFFIXES = (".py", ".cpp", ".h")
 # THIS repo (detected by this script's own path) — fabricated test
 # repos are exempt.
 REQUIRED_ARTIFACTS = ("OBS_r09.json", "WIRE_r10.json", "OBS2_r11.json",
-                      "CENSUS_r12.json", "CHAOS_r13.json")
+                      "CENSUS_r12.json", "CHAOS_r13.json",
+                      "REBALANCE_r14.json")
 
 
 def _tracked_files(root: Path) -> list[Path]:

@@ -277,7 +277,7 @@ def check_digest_boundary(project: Project) -> Iterator[Finding]:
 # deployment to the default — the drift this rule exists to catch)
 _CLI_CLASSES = ("NodeConfig", "ServeConfig", "IngestConfig", "ObsConfig",
                 "FragmenterConfig", "CensusConfig", "DurabilityConfig",
-                "ChaosConfig")
+                "ChaosConfig", "RingConfig")
 # config field -> /metrics key that surfaces it, per stats function.
 # "cas" carries cas_io_threads as its nested workers count
 # (store/aio.py stats()).
@@ -330,6 +330,12 @@ _CHAOS_METRIC_KEYS = {"enabled": "enabled", "seed": "seed",
                       "disk_full": "diskFull",
                       "disk_delay_s": "diskDelayS",
                       "crash_point": "crashPoint"}
+
+
+# membership-ring knobs surface under /metrics "ring"
+# (node/runtime.py ring_stats())
+_RING_METRIC_KEYS = {"vnodes": "vnodes", "members": "members",
+                     "rebalance_credit_bytes": "rebalanceCreditBytes"}
 
 
 def _dataclass_fields(src: SourceFile) -> dict[str, dict[str, int]]:
@@ -489,7 +495,8 @@ def check_config_drift(project: Project) -> Iterator[Finding]:
              _CENSUS_METRIC_KEYS),
             (runtime, "durability_stats", "DurabilityConfig",
              _DURABILITY_METRIC_KEYS),
-            (chaos_pkg, "stats", "ChaosConfig", _CHAOS_METRIC_KEYS)):
+            (chaos_pkg, "stats", "ChaosConfig", _CHAOS_METRIC_KEYS),
+            (runtime, "ring_stats", "RingConfig", _RING_METRIC_KEYS)):
         if src is None or src.tree is None or cls not in classes:
             continue
         keys = _stats_dict_keys(src, func)

@@ -17,8 +17,9 @@ Four layers of coverage:
   upload path, restart, and assert the durability contract — no
   manifest references a missing local chunk and every acked file reads
   back byte-identical; plus the ``bench_chaos.py --tiny`` subprocess
-  smoke gating all four scripted scenarios end to end (CHAOS_r13.json
-  schema + invariants).
+  smoke gating all five scripted scenarios (the four fault scenarios
+  and the r14 add/kill/rejoin/drain membership scenario) end to end
+  (CHAOS_r13.json schema + invariants).
 """
 
 from __future__ import annotations
@@ -576,11 +577,13 @@ def test_kill9_at_every_crash_point_then_restart(tmp_path, rng):
 
 
 def test_bench_chaos_tiny_smoke(tmp_path):
-    """The full harness, end to end: ``bench_chaos.py --tiny`` runs all
-    four scripted scenarios against a real 3-process cluster and must
-    gate green — zero acked-write loss, byte-identity, no phantom
-    sheds, stitched traces, correct doctor/census findings. Also locks
-    the CHAOS_r13.json schema the committed artifact embeds."""
+    """The full harness, end to end: ``bench_chaos.py --tiny`` runs the
+    four fault scenarios against a real 3-process cluster plus the r14
+    membership scenario (join mid-ingest, SIGKILL mid-rebalance,
+    rejoin, drain) on its own 4-process ring cluster — all must gate
+    green: zero acked-write loss, byte-identity, no phantom sheds,
+    stitched traces, correct doctor/census findings. Also locks the
+    CHAOS_r13.json schema the committed artifact embeds."""
     out_path = tmp_path / "chaos_tiny.json"
     res = subprocess.run(
         [sys.executable, str(REPO / "bench_chaos.py"), "--tiny",
@@ -600,7 +603,8 @@ def test_bench_chaos_tiny_smoke(tmp_path):
     assert out["ok"] is True
     scenarios = out["scenarios"]
     assert set(scenarios) == {"slow_peer", "partition",
-                              "crash_restart", "disk_full"}
+                              "crash_restart", "disk_full",
+                              "add_remove_node"}
     for name, s in scenarios.items():
         assert s["ok"] is True, name
         assert s["zero_acked_loss"] and s["byte_identical"], name
@@ -613,6 +617,8 @@ def test_bench_chaos_tiny_smoke(tmp_path):
     assert scenarios["crash_restart"]["crash_point_fired_sigkill"]
     assert scenarios["disk_full"]["full_node_answers_507"]
     assert scenarios["disk_full"]["full_node_reads_ok"]
+    assert scenarios["add_remove_node"]["over_replicated"] == 0
+    assert scenarios["add_remove_node"]["node4_drained_empty"]
     assert scenarios["disk_full"]["no_500s"]
 
     # schema lock against the COMMITTED artifact: same keys, so the

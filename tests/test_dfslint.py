@@ -394,6 +394,59 @@ def test_dfs005_chaos_fields_checked(tmp_path):
                            "dfs_tpu/chaos/__init__.py": chaos_ok}) == []
 
 
+def test_dfs005_ring_fields_checked(tmp_path):
+    """r14: RingConfig rides the same three DFS005 edges — a membership
+    knob dropped from cmd_serve's constructor, and one whose /metrics
+    key vanishes from ring_stats(), must both be findings; the wired
+    fixture must be clean."""
+    cfg = (
+        "import dataclasses\n"
+        "@dataclasses.dataclass(frozen=True)\n"
+        "class RingConfig:\n"
+        "    vnodes: int = 0\n"
+        "    rebalance_credit_bytes: int = 0\n")
+    cli_missing = (
+        "from dfs_tpu.config import RingConfig\n"
+        "def cmd_serve(args):\n"
+        "    return RingConfig(vnodes=args.ring_vnodes)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--ring-vnodes', type=int, default=0)\n")
+    runtime_ok = (
+        "class S:\n"
+        "    def ring_stats(self):\n"
+        "        return {'vnodes': 0, 'rebalanceCreditBytes': 0}\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/cli/main.py": cli_missing,
+                            "dfs_tpu/node/runtime.py": runtime_ok})
+    assert rules_of(found) == ["DFS005"]
+    assert "RingConfig.rebalance_credit_bytes" in found[0].message
+
+    cli_ok = (
+        "from dfs_tpu.config import RingConfig\n"
+        "def cmd_serve(args):\n"
+        "    return RingConfig(vnodes=args.ring_vnodes,\n"
+        "                      rebalance_credit_bytes="
+        "args.ring_rebalance_credit_bytes)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--ring-vnodes', type=int, default=0)\n"
+        "    sub.add_argument('--ring-rebalance-credit-bytes',\n"
+        "                     type=int, default=0)\n")
+    runtime_missing_key = (
+        "class S:\n"
+        "    def ring_stats(self):\n"
+        "        return {'vnodes': 0}\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/cli/main.py": cli_ok,
+                            "dfs_tpu/node/runtime.py":
+                            runtime_missing_key})
+    assert rules_of(found) == ["DFS005"]
+    assert "rebalanceCreditBytes" in found[0].message
+
+    assert lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                           "dfs_tpu/cli/main.py": cli_ok,
+                           "dfs_tpu/node/runtime.py": runtime_ok}) == []
+
+
 def test_dfs005_unmapped_field_needs_table_entry(tmp_path):
     cfg = (
         "import dataclasses\n"

@@ -1,0 +1,521 @@
+"""Elastic membership plane (dfs_tpu/ring, docs/membership.md):
+
+- RING MATH: static mode byte-stable with the legacy cyclic placement;
+  hash-mode balance (owned-fraction spread < 10 points at 64 vnodes)
+  and MINIMAL MOVEMENT on add/remove/reweight (the property the whole
+  subsystem exists for); serialization + validation; weight-0 drain.
+- EPOCH PROTOCOL: a stale peer answers RingEpochMismatch and the two
+  sides converge (client adopts a newer map from the refusal; a stale
+  SERVER gets the newer map pushed) — placement-bearing RPCs can never
+  silently mis-place across a membership change.
+- DUAL-READ WINDOW: mid-migration reads consult previous-epoch owners
+  and count dualReadHits — no read fails while bytes are still at
+  their old home.
+- IN-PROCESS 3->4 ADD: a real asyncio cluster adds a standby node
+  mid-catalog, repair cycles converge the migration, and every file
+  reads back byte-identical from every node throughout; drain empties
+  the node again and the census comes back fully clean.
+- the ``bench_rebalance.py --tiny`` subprocess smoke gating the full
+  3->4->3 real-process scenario end to end (REBALANCE_r14.json schema
+  + invariants).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dfs_tpu.config import (CDCParams, CensusConfig, ClusterConfig,
+                            NodeConfig, PeerAddr, RingConfig)
+from dfs_tpu.node.placement import (ec_shard_node, handoff_order,
+                                    replica_set)
+from dfs_tpu.ring import RingMap, RingMember, digest_point
+from dfs_tpu.ring.manager import ByteRate, RingManager
+from dfs_tpu.utils.hashing import sha256_hex
+
+REPO = Path(__file__).resolve().parent.parent
+CDC = CDCParams(min_size=64, avg_size=256, max_size=1024)
+CENSUS_OFF = CensusConfig(history_interval_s=0)
+
+
+def _digests(n: int) -> list[str]:
+    return [sha256_hex(f"ring-pt-{i}".encode()) for i in range(n)]
+
+
+# ------------------------------------------------------------------ #
+# ring math
+# ------------------------------------------------------------------ #
+
+def test_static_mode_byte_stable_with_legacy_placement():
+    """Epoch-0 static maps MUST reproduce the pre-r14 cyclic mod-N
+    placement exactly — existing stores keep their layout. The legacy
+    formula is re-derived here independently so a refactor of the ring
+    module cannot silently shift it."""
+    ids = [1, 2, 3, 4, 5]
+    ring = RingMap.static(ids)
+    for d in _digests(200):
+        start = int(d[:16], 16) % len(ids)
+        legacy = [ids[(start + j) % len(ids)] for j in range(2)]
+        assert ring.owners(d, 2) == legacy
+        assert replica_set(d, ids, 2) == legacy       # placement shim
+    # EC + handoff shims stay static math too
+    fid = _digests(1)[0]
+    base = (int(fid[:16], 16) + 3 * 2654435761) % len(ids)
+    assert ec_shard_node(fid, 3, 2, ids) == ids[(base + 2) % len(ids)]
+    assert ring.ec_shard_node(fid, 3, 2) == ids[(base + 2) % len(ids)]
+    assert handoff_order([3, 1], ids) == ring.handoff_order([3, 1])
+
+
+@pytest.mark.parametrize("n", [3, 4, 5])
+def test_hash_ring_balance_at_64_vnodes(n):
+    """Owned-fraction spread (max - min) stays under 10 percentage
+    points at the default 64 vnodes — the balance the bench's
+    moved-vs-minimum accounting leans on."""
+    ring = RingMap.hashed({i: 1.0 for i in range(1, n + 1)}, epoch=1,
+                          vnodes=64)
+    counts = dict.fromkeys(range(1, n + 1), 0)
+    pts = _digests(4000)
+    for d in pts:
+        counts[ring.owners(d, 1)[0]] += 1
+    fr = sorted(v / len(pts) for v in counts.values())
+    assert fr[-1] - fr[0] < 0.10, fr
+
+
+def _moved_fraction(old: RingMap, new: RingMap, rf: int = 2,
+                    npts: int = 3000) -> float:
+    moved = total = 0
+    for d in _digests(npts):
+        a, b = set(old.owners(d, rf)), set(new.owners(d, rf))
+        moved += len(b - a)
+        total += len(b)
+    return moved / total
+
+
+def test_minimal_movement_on_add_remove_reweight():
+    """THE consistent-hashing property: adding one node at equal
+    weight moves ~1/(N+1) of the copy space (the mod-N scheme moved
+    ~all of it); removal and reweight are similarly proportional."""
+    w3 = {1: 1.0, 2: 1.0, 3: 1.0}
+    r3 = RingMap.hashed(w3, 1, 64)
+    r4 = RingMap.hashed({**w3, 4: 1.0}, 2, 64)
+    assert _moved_fraction(r3, r4) <= 1 / 4 + 0.06
+    # removal: only the removed member's share remaps
+    assert _moved_fraction(r4, r3) <= 1 / 4 + 0.06
+    # drain (weight 0) places exactly like removal, but keeps the
+    # member listed on its way out
+    rd = RingMap.hashed({**w3, 4: 0.0}, 3, 64)
+    for d in _digests(300):
+        assert rd.owners(d, 2) == r3.owners(d, 2)
+        assert 4 not in rd.owners(d, 3)
+    assert rd.active_ids() == [1, 2, 3]
+    # reweight: halving one member moves a bounded slice, not the world
+    rh = RingMap.hashed({1: 0.5, 2: 1.0, 3: 1.0}, 4, 64)
+    frac = _moved_fraction(r3, rh)
+    assert 0.0 < frac <= 0.25, frac
+
+
+def test_ring_map_serialization_and_validation():
+    ring = RingMap.hashed({1: 1.0, 2: 0.5}, epoch=7, vnodes=64)
+    back = RingMap.from_dict(json.loads(json.dumps(ring.to_dict())))
+    assert back == ring
+    for d in _digests(50):
+        assert back.owners(d, 2) == ring.owners(d, 2)
+    with pytest.raises(ValueError):
+        RingMap.from_dict({"members": []})          # no epoch
+    with pytest.raises(ValueError):
+        RingMap.from_dict("nope")
+    with pytest.raises(ValueError):
+        RingMap(epoch=0, vnodes=0, members=(
+            RingMember(1), RingMember(1)))          # duplicate id
+    with pytest.raises(ValueError):                 # static + weights
+        RingMap(epoch=0, vnodes=0, members=(RingMember(1, weight=2.0),))
+    with pytest.raises(ValueError):
+        RingConfig(members="1,x")
+    assert RingConfig(members="3,1,2").member_ids() == [1, 2, 3]
+    # deterministic from the compact map alone: two instances agree
+    again = RingMap.hashed({1: 1.0, 2: 0.5}, epoch=7, vnodes=64)
+    d = _digests(1)[0]
+    assert again.owners_at(digest_point(d), 2) == \
+        ring.owners_at(digest_point(d), 2)
+
+
+def test_tiny_weight_member_still_owns_space():
+    """Review regression: a small positive weight must never round to
+    ZERO vnodes — the member would count as active while owning
+    nothing, and every write would silently place rf-1 copies."""
+    ring = RingMap.hashed({1: 1.0, 2: 1.0, 3: 0.005}, epoch=1,
+                          vnodes=64)
+    assert ring.active_ids() == [1, 2, 3]
+    for d in _digests(200):
+        assert len(ring.owners(d, 2)) == 2
+    assert len(ring.owners(_digests(1)[0], 3)) == 3
+    assert len(ring.ec_stripe_nodes(_digests(1)[0], 0, 3)) == 3
+
+
+def test_same_epoch_racing_admins_converge(tmp_path):
+    """Review regression: two admins racing on different nodes both
+    build DIFFERENT epoch-1 maps from epoch 0. The (epoch,
+    fingerprint) total order must make every node deterministically
+    pick the same winner — epoch comparison alone left the cluster
+    permanently split across two same-epoch maps."""
+    cluster = ClusterConfig.localhost(4)
+    a = RingManager(NodeConfig(node_id=1, cluster=cluster,
+                               data_root=tmp_path,
+                               ring=RingConfig(vnodes=64,
+                                               members="1,2,3")),
+                    tmp_path / "a")
+    b = RingManager(NodeConfig(node_id=2, cluster=cluster,
+                               data_root=tmp_path,
+                               ring=RingConfig(vnodes=64,
+                                               members="1,2,3")),
+                    tmp_path / "b")
+    map_a = a.propose_next({1: 1.0, 2: 1.0, 3: 1.0, 4: 1.0})  # add 4
+    map_b = b.propose_next({1: 1.0, 2: 0.5, 3: 1.0})   # reweight 2
+    assert map_a.epoch == map_b.epoch == 1
+    assert map_a.fingerprint != map_b.fingerprint
+    assert a.install(map_a) and b.install(map_b)       # the race
+    # gossip in BOTH directions (order must not matter): exactly one
+    # side adopts, both end on the same winner
+    a_adopted = a.adopt(map_b.to_dict())
+    b_adopted = b.adopt(map_a.to_dict())
+    assert a_adopted != b_adopted
+    assert a.current.key == b.current.key
+    winner = max((map_a, map_b),
+                 key=lambda m: (m.epoch, m.fingerprint))
+    assert a.current.key == winner.key
+
+
+def test_byte_rate_bounds_long_run_rate():
+    """The rebalance credit bucket: pushing 3 credit-seconds of bytes
+    takes >= ~2s of stalls — the long-run rate is bounded."""
+    async def run():
+        rate = ByteRate(100_000)
+        t0 = time.monotonic()
+        stalled = 0.0
+        for _ in range(3):
+            stalled += await rate.acquire(100_000)
+        return time.monotonic() - t0, stalled
+
+    took, stalled = asyncio.run(run())
+    assert took >= 1.5 and stalled >= 1.5
+    # disabled gate never sleeps
+    assert asyncio.run(ByteRate(0).acquire(10**9)) == 0.0
+
+
+def test_ring_manager_persistence_and_resume(tmp_path):
+    cluster = ClusterConfig.localhost(3)
+    cfg = NodeConfig(node_id=1, cluster=cluster, data_root=tmp_path,
+                     ring=RingConfig(vnodes=64))
+    mgr = RingManager(cfg, tmp_path)
+    assert mgr.epoch == 0 and not mgr.migrating
+    new = mgr.propose_next({1: 1.0, 2: 1.0})
+    assert mgr.install(new) and mgr.epoch == 1 and mgr.migrating
+    assert not mgr.install(new)                  # idempotent
+    # a fresh manager over the same root resumes epoch AND the open
+    # migration window (kill -9 mid-rebalance; the harness scenario)
+    mgr2 = RingManager(cfg, tmp_path)
+    assert mgr2.epoch == 1 and mgr2.migrating
+    assert mgr2.previous is not None and mgr2.previous.epoch == 0
+    mgr2.finish_migration()
+    mgr3 = RingManager(cfg, tmp_path)
+    assert mgr3.epoch == 1 and not mgr3.migrating
+
+
+# ------------------------------------------------------------------ #
+# doctor + census units
+# ------------------------------------------------------------------ #
+
+def test_doctor_epoch_mismatch_and_rebalance_stuck():
+    from dfs_tpu.obs.doctor import diagnose
+
+    now = time.time()
+    snaps = {
+        1: {"nodeId": 1, "now": now, "receivedAt": now,
+            "ring": {"epoch": 3, "migrating": False}},
+        2: {"nodeId": 2, "now": now, "receivedAt": now,
+            "ring": {"epoch": 2, "migrating": True,
+                     "sinceProgressS": 500.0, "bytesMoved": 123}},
+    }
+    rules = {f["rule"]: f for f in diagnose(snaps, now)}
+    assert rules["epoch_mismatch"]["peers"] == [2]
+    assert "epoch 2" in rules["epoch_mismatch"]["evidence"]
+    assert rules["rebalance_stuck"]["peers"] == [2]
+    # converged + progressing cluster stays quiet
+    snaps[2]["ring"] = {"epoch": 3, "migrating": True,
+                        "sinceProgressS": 1.0}
+    rules = {f["rule"] for f in diagnose(snaps, now)}
+    assert "epoch_mismatch" not in rules
+    assert "rebalance_stuck" not in rules
+
+
+def test_census_inflight_not_phantom_findings():
+    """Mid-migration copies at previous-epoch owners are IN-FLIGHT, not
+    under-/over-replication: one rebalance must not light up phantom
+    findings (the r14 census satellite)."""
+    from dfs_tpu.obs.census import build_report, summarize_expected
+
+    d1, d2 = _digests(2)
+    # d1: rf=2 moving {1,2}->{2,3}; node 3's copy pending, node 1 still
+    # holds. d2: fully migrated but node 1's stray not yet relocated.
+    expected = {d1: (1, 2, 3), d2: (1, 2, 3)}     # union of epochs
+    cur = {d1: (2, 3), d2: (2, 3)}                # current epoch
+    lengths = {d1: 100, d2: 100}
+
+    def inv_for(nid, holds):
+        table = summarize_expected(
+            {d: (nid,) for d in holds}, lengths)
+        return {"buckets": table.get(nid, {})}
+
+    inventories = {1: inv_for(1, [d1, d2]), 2: inv_for(2, [d1, d2]),
+                   3: inv_for(3, [d2])}
+    # node 3's summary mismatches its (union) expectation -> drilled
+    drilled = {3: {p: [d2[:64]] if p == d2[:2] else []
+                   for p in {d1[:2], d2[:2]}}}
+    rep = build_report(expected, lengths, inventories, drilled, 16,
+                       cur_expected=cur)
+    assert rep["underReplicatedTotal"] == 0       # d1 is mid-move
+    assert rep["overReplicatedTotal"] == 0        # d2's stray is legit
+    assert rep["orphanedTotal"] == 0
+    assert rep["inFlightTotal"] >= 1
+    # same observations WITHOUT the migration window = real findings
+    rep2 = build_report(cur, lengths,
+                        {2: inv_for(2, [d1, d2]),
+                         3: inv_for(3, [d2])},
+                        {3: drilled[3]}, 16)
+    assert rep2["underReplicatedTotal"] == 1      # d1 below rf for real
+
+
+# ------------------------------------------------------------------ #
+# in-process cluster: epoch protocol, dual reads, add/drain
+# ------------------------------------------------------------------ #
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _mk_cluster(n: int, rf: int = 2) -> ClusterConfig:
+    ports = _free_ports(2 * n)
+    peers = tuple(PeerAddr(node_id=i + 1, host="127.0.0.1",
+                           port=ports[2 * i],
+                           internal_port=ports[2 * i + 1])
+                  for i in range(n))
+    return ClusterConfig(peers=peers, replication_factor=rf)
+
+
+async def _start_nodes(cluster, root, ids=None, **cfg_kw):
+    from dfs_tpu.node.runtime import StorageNodeServer
+
+    cfg_kw.setdefault("cdc", CDC)
+    cfg_kw.setdefault("census", CENSUS_OFF)
+    nodes = {}
+    for p in cluster.peers:
+        if ids is not None and p.node_id not in ids:
+            continue
+        cfg = NodeConfig(node_id=p.node_id, cluster=cluster,
+                         data_root=root, fragmenter="cdc", **cfg_kw)
+        node = StorageNodeServer(cfg)
+        await node.start()
+        nodes[p.node_id] = node
+    return nodes
+
+
+async def _stop_nodes(nodes) -> None:
+    for n in nodes.values():
+        await n.stop()
+
+
+async def _converge(nodes, timeout: float = 30.0) -> None:
+    """Drive repair cycles until every node's migration window closed."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for n in nodes.values():
+            await n.repair_once()
+        if not any(n.ring.migrating for n in nodes.values()):
+            return
+    raise AssertionError("migration never converged: " + str(
+        {i: n.ring.rebalance_stats() for i, n in nodes.items()}))
+
+
+def test_epoch_mismatch_refresh_both_directions(tmp_path, rng):
+    """A stale SERVER learns the newer map from the caller's push; a
+    stale CLIENT adopts the map straight off the refusal — either way
+    the placement-bearing op retries converged and succeeds."""
+    data = rng.integers(0, 256, size=30_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = _mk_cluster(3)
+        nodes = await _start_nodes(cluster, tmp_path,
+                                   ring=RingConfig(vnodes=64))
+        try:
+            # bump node 1 ONLY (no push): nodes 2/3 are stale servers
+            new = nodes[1].ring.propose_next(
+                {1: 1.0, 2: 1.0, 3: 1.0})
+            nodes[1].ring.install(new, source="test")
+            assert nodes[2].ring.epoch == 0
+            m, _ = await nodes[1].upload(data, "fresh.bin")
+            # the upload's store_chunks carried repoch=1 -> stale
+            # peers answered mismatch -> got the map pushed -> retried
+            assert nodes[2].ring.epoch == 1
+            assert nodes[3].ring.epoch == 1
+            # now a stale CLIENT: roll node 2 back and read through it
+            nodes[2].ring.current = RingMap.hashed(
+                {1: 1.0, 2: 1.0, 3: 1.0}, 0, 64)
+            nodes[2].ring.previous = None
+            _, got = await nodes[2].download(m.file_id)
+            assert bytes(got) == data
+            assert nodes[2].ring.epoch == 1    # adopted off the refusal
+            # somebody refused at least one stale op along the way
+            assert sum(n.counters.snapshot().get(
+                "ring_epoch_mismatches", 0)
+                for n in nodes.values()) >= 1
+        finally:
+            await _stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_dual_read_window_serves_unmigrated_bytes(tmp_path, rng):
+    """Mid-migration, a chunk whose new owner has not received it yet
+    is served from its previous-epoch owner (and counted as a
+    dualReadHit) — no read fails mid-move."""
+    data = rng.integers(0, 256, size=40_000, dtype=np.uint8).tobytes()
+
+    async def run():
+        cluster = _mk_cluster(2, rf=1)
+        nodes = await _start_nodes(cluster, tmp_path,
+                                   ring=RingConfig(vnodes=64,
+                                                   members="1"))
+        try:
+            m, _ = await nodes[1].upload(data, "move-me.bin")
+            # freeze the rebalancer so the window stays open
+            for n in nodes.values():
+                async def _noop(self=None):
+                    return 0
+                n.repair_once = _noop       # type: ignore[assignment]
+            flip = RingMap.hashed({2: 1.0}, epoch=1, vnodes=64)
+            for n in nodes.values():
+                n.ring.install(flip, source="test")
+                assert n.ring.migrating
+            # every byte still sits on node 1; current owner is node 2
+            _, got = await nodes[2].download(m.file_id)
+            assert bytes(got) == data
+            assert nodes[2].ring.rebalance_stats()["dualReadHits"] > 0
+        finally:
+            await _stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+def test_add_then_drain_node_byte_identical_reads(tmp_path, rng):
+    """The in-process 3->4->3 scenario: add a standby node to the ring
+    mid-catalog, converge, read every file byte-identical from EVERY
+    node (including the new one), then drain it empty again with a
+    fully clean census."""
+    payloads = [rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+                for n in (20_000, 35_000, 50_000)]
+
+    async def run():
+        cluster = _mk_cluster(4)
+        nodes = await _start_nodes(
+            cluster, tmp_path,
+            ring=RingConfig(vnodes=64, members="1,2,3",
+                            rebalance_credit_bytes=0))
+        try:
+            manifests = []
+            for i, payload in enumerate(payloads):
+                m, _ = await nodes[(i % 3) + 1].upload(
+                    payload, f"f{i}.bin")
+                manifests.append(m)
+            assert nodes[4].store.chunks.count() == 0  # standby: empty
+            out = await nodes[1].ring_admin("add", node_id=4)
+            assert out["epoch"] == 1 and all(out["pushed"].values())
+            await _converge(nodes)
+            assert nodes[4].store.chunks.count() > 0   # data moved in
+            moved = sum(n.ring.rebalance_stats()["bytesMoved"]
+                        for n in nodes.values())
+            assert moved > 0
+            for nid, node in nodes.items():
+                for m, payload in zip(manifests, payloads):
+                    _, got = await node.download(m.file_id)
+                    assert bytes(got) == payload, (nid, m.file_id)
+            # drain: node 4 gives everything back and empties
+            out = await nodes[1].ring_admin("drain", node_id=4)
+            assert out["epoch"] == 2
+            await _converge(nodes)
+            # relocation needs confirmed canonical holders: run one
+            # more settling cycle, then the census must be fully clean
+            for n in nodes.values():
+                await n.repair_once()
+            rep = await nodes[1].census_report()
+            assert rep["underReplicatedTotal"] == 0
+            assert rep["overReplicatedTotal"] == 0
+            assert rep["orphanedTotal"] == 0
+            assert rep["inFlightTotal"] == 0
+            assert nodes[4].store.chunks.count() == 0
+            for m, payload in zip(manifests, payloads):
+                _, got = await nodes[2].download(m.file_id)
+                assert bytes(got) == payload
+        finally:
+            await _stop_nodes(nodes)
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------------------ #
+# the real-process bench smoke (REBALANCE_r14.json)
+# ------------------------------------------------------------------ #
+
+def test_bench_rebalance_tiny_smoke(tmp_path):
+    """``bench_rebalance.py --tiny``: the full 3->4->3 real-process
+    add+drain under open-loop load must gate green — zero failed
+    reads, zero acked-write loss, movement within the theoretical
+    bound, credit-bounded bandwidth, clean census. Also locks the
+    schema the committed REBALANCE_r14.json embeds."""
+    out_path = tmp_path / "rebalance_tiny.json"
+    res = subprocess.run(
+        [sys.executable, str(REPO / "bench_rebalance.py"), "--tiny",
+         "--out", str(out_path)],
+        cwd=tmp_path, capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": str(REPO)})
+    os.sync()   # drain our writeback before the next fsync-mode test
+    assert res.returncode == 0, (
+        f"bench_rebalance --tiny failed:\n{res.stdout[-2000:]}"
+        f"\n{res.stderr[-4000:]}")
+    out = json.loads(out_path.read_text())
+    assert out["metric"] == "rebalance_invariants" and out["round"] == 14
+    assert out["ok"] is True
+    assert out["zero_failed_reads"] and out["zero_acked_loss"]
+    for phase in ("add", "drain"):
+        assert out[phase]["moved_within_bound"], out[phase]
+        assert out[phase]["bandwidth_ok"], out[phase]
+        assert out[phase]["moved_bytes"] > 0
+    assert out["census"]["clean"]
+    assert out["census"]["node4_cas_chunks"] == 0
+    # schema lock: the committed artifact carries the same shape
+    committed = json.loads((REPO / "REBALANCE_r14.json").read_text())
+    assert committed["metric"] == "rebalance_invariants"
+    assert committed["ok"] is True
+    assert set(committed) >= set(out) - {"lost"}
+    for phase in ("add", "drain"):
+        assert set(committed[phase]) == set(out[phase])
+        assert committed[phase]["moved_within_bound"]
+        assert committed[phase]["bandwidth_ok"]
+    assert committed["zero_failed_reads"] and committed["zero_acked_loss"]

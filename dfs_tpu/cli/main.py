@@ -49,7 +49,8 @@ def cmd_serve(args) -> int:
         cdc=CDCParams(min_size=args.min_chunk, avg_size=args.avg_chunk,
                       max_size=args.max_chunk),
         frag=FragmenterConfig(devices=args.cdc_devices,
-                              region_bytes=args.cdc_region_bytes),
+                              region_bytes=args.cdc_region_bytes,
+                              staging_buffers=args.cdc_staging_buffers),
         fixed_parts=args.fixed_parts,
         connect_timeout_s=args.connect_timeout,
         request_timeout_s=args.request_timeout,
@@ -495,12 +496,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="default 'auto': the flagship anchored pipeline — TPU device "
              "path when a TPU is present, CPU oracle otherwise")
     serve.add_argument("--cdc-devices", type=int, default=0,
-                       help="shard 'cdc' streaming regions over N JAX "
-                            "devices (0/1 = single-device; boundaries "
-                            "are byte-identical either way)")
+                       help="shard 'cdc' / 'cdc-anchored' streaming "
+                            "regions over N JAX devices (0/1 = single-"
+                            "device; boundaries are byte-identical "
+                            "either way)")
     serve.add_argument("--cdc-region-bytes", type=int, default=0,
                        help="fixed device-region size for sharded CDC "
-                            "(0 = devices * 1 MiB)")
+                            "(0 = devices * 1 MiB rolling / 64 MiB "
+                            "anchored)")
+    serve.add_argument("--cdc-staging-buffers", type=int, default=2,
+                       help="host staging buffers the sharded anchored "
+                            "walk cycles through (2 = double-buffered "
+                            "staging/compute overlap, 1 = serial)")
     serve.add_argument("--min-chunk", type=int, default=2048)
     serve.add_argument("--avg-chunk", type=int, default=8192)
     serve.add_argument("--max-chunk", type=int, default=65536)

@@ -145,12 +145,16 @@ class FragmenterConfig:
     boundaries, which these knobs must never change).
 
     ``devices > 1`` shards streaming-CDC regions over that many JAX
-    devices via ``parallel/sharded_cdc.make_sharded_bitmap_step`` (the
-    31-byte Gear halo rides the sp ring via ppermute; the stream's
-    region-to-region halo is carried in host-side) — chunk boundaries
-    stay BYTE-IDENTICAL to the single-device path by construction
-    (tests/test_sharded_ingest.py asserts it). With fewer devices
-    visible than asked, the fragmenter logs once and runs single-device.
+    devices: the ROLLING ``cdc`` strategy via ``parallel/sharded_cdc.
+    make_sharded_bitmap_step`` (the 31-byte Gear halo rides the sp ring
+    via ppermute; the stream's region-to-region halo is carried in
+    host-side), and the flagship ANCHORED strategy via the sharded
+    anchor/segment passes (``make_anchored_anchor_step`` /
+    ``make_anchored_step``, fragmenter/cdc_anchored_sharded.py) — chunk
+    boundaries stay BYTE-IDENTICAL to the single-device path by
+    construction (tests/test_sharded_ingest.py asserts it). With fewer
+    devices visible than asked, the fragmenter logs once and runs
+    single-device.
     """
 
     devices: int = 0        # 0/1 = single-device CDC; N > 1 = shard
@@ -158,15 +162,25 @@ class FragmenterConfig:
     region_bytes: int = 0   # fixed device-region size streaming input is
                             # re-blocked to (the sharded step compiles
                             # ONCE for this shape); 0 = devices * 1 MiB
+                            # (rolling) / 64 MiB split across the
+                            # window batch (anchored)
+    staging_buffers: int = 2  # host staging buffers the sharded anchored
+                            # walk cycles through: 2 = double-buffered
+                            # (device_put region k+1 while region k
+                            # computes); 1 = strictly serial staging
 
     def __post_init__(self) -> None:
+        # no cross-field region/devices constraint here: alignment is
+        # strategy-owned (the rolling walk floors the region to a
+        # devices multiple, the anchored walk to the anchor tile — both
+        # via sharded_common.fixed_region_bytes), and a rule written
+        # for one strategy rejected valid configs of the other
         if self.devices < 0:
             raise ValueError("devices must be >= 0")
         if self.region_bytes < 0:
             raise ValueError("region_bytes must be >= 0")
-        if self.region_bytes and self.devices > 1 \
-                and self.region_bytes % self.devices:
-            raise ValueError("region_bytes must divide evenly over devices")
+        if self.staging_buffers < 1:
+            raise ValueError("staging_buffers must be >= 1")
 
 
 @dataclasses.dataclass(frozen=True)

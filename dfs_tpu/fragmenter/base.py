@@ -375,8 +375,11 @@ def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5,
 
     ``frag`` (a FragmenterConfig) carries execution knobs: with
     ``frag.devices > 1`` the ``"cdc"`` strategy's streaming walk shards
-    regions over that many JAX devices (fragmenter/cdc_sharded.py) —
-    byte-identical chunk boundaries, multi-chip throughput."""
+    regions over that many JAX devices (fragmenter/cdc_sharded.py), and
+    the flagship ``"cdc-anchored"`` strategy's region walk shards over
+    the same mesh with double-buffered staging
+    (fragmenter/cdc_anchored_sharded.py) — byte-identical chunk
+    boundaries, multi-chip throughput."""
     import warnings
 
     from dfs_tpu.config import CDCParams
@@ -385,6 +388,17 @@ def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5,
     from dfs_tpu.fragmenter.fixed import FixedFragmenter
 
     if kind == "auto":
+        if frag is not None and frag.devices > 1:
+            # auto's job is the TPU-vs-CPU link probe; it does not
+            # compose with the sharded walks. Silence here would be
+            # indistinguishable from sharding working (/metrics frag
+            # reports the configured device count either way).
+            import logging
+
+            logging.getLogger("dfs_tpu.fragmenter").warning(
+                "--cdc-devices is ignored by fragmenter='auto'; use "
+                "fragmenter='cdc-anchored' (or 'cdc') for multi-device "
+                "ingest")
         return AutoAnchoredFragmenter(_anchored_params(cdc_params))
     if kind == "fixed":
         return FixedFragmenter(parts=fixed_parts)
@@ -393,6 +407,25 @@ def get_fragmenter(kind: str, *, cdc_params=None, fixed_parts: int = 5,
                                                      AnchoredTpuFragmenter)
 
         params = _anchored_params(cdc_params)
+        if frag is not None and frag.devices > 1:
+            if kind == "cdc-anchored":
+                # the flagship ANCHORED walk sharded over the mesh
+                # (r15): identical chunks, multi-chip region compute;
+                # degraded environments fall back to the host engine
+                from dfs_tpu.fragmenter.cdc_anchored_sharded import \
+                    ShardedAnchoredCdcFragmenter
+
+                return ShardedAnchoredCdcFragmenter(params, frag)
+            # the single-device TPU pipeline does not compose with the
+            # sharded walk; silence would be indistinguishable from
+            # sharding working (/metrics frag reports the configured
+            # device count either way)
+            import logging
+
+            logging.getLogger("dfs_tpu.fragmenter").warning(
+                "--cdc-devices is ignored by fragmenter="
+                "'cdc-anchored-tpu'; use fragmenter='cdc-anchored' for "
+                "multi-device ingest")
         cls = AnchoredCpuFragmenter if kind == "cdc-anchored" \
             else AnchoredTpuFragmenter
         return cls(params)

@@ -67,6 +67,50 @@ def _to_u8(data) -> np.ndarray:
     return np.frombuffer(data, dtype=np.uint8)
 
 
+class _StagingMeter:
+    """Staging-bandwidth self-measurement shared by the pipelined walks
+    (``AnchoredTpuFragmenter``'s single-device window pipeline, round 6;
+    ``ShardedAnchoredCdcFragmenter``'s double-buffered mesh staging,
+    round 15): a bounded record of (bytes, seconds) for the transfers
+    the walk actually timed, plus the public reset/inspect surface
+    benches scope their aggregates with. Bounded: a long-lived node on a
+    slow link measures every window forever, and a lifetime average
+    would mix samples hours apart."""
+
+    def _init_staging(self, overlap_min_bw: float) -> None:
+        import collections
+
+        self.overlap_min_bw = float(overlap_min_bw)
+        self._staging_bw: float | None = None
+        self._since_measure = _REMEASURE_EVERY  # first window measures
+        self._staging_samples: collections.deque[tuple[int, float]] = \
+            collections.deque(maxlen=64)
+
+    def staging_observed_bw(self) -> float | None:
+        """Aggregate bandwidth of the recent transfers the walk timed
+        (up to the deque bound — the same-run link number its e2e rate
+        is honestly comparable to); None before any walk. Scope the
+        aggregate to one run with :meth:`reset_staging_samples` before
+        it (as bench_e2e_stream does)."""
+        if not self._staging_samples:
+            return None
+        return (sum(b for b, _ in self._staging_samples)
+                / sum(t for _, t in self._staging_samples))
+
+    def reset_staging_samples(self) -> int:
+        """Forget the recorded window-transfer timings (scoping the next
+        :meth:`staging_observed_bw` aggregate to the next run); returns
+        how many samples were dropped. The public face of the private
+        deque — benches must not reach into ``_staging_samples``."""
+        n = len(self._staging_samples)
+        self._staging_samples.clear()
+        return n
+
+    def staging_timed_windows(self) -> int:
+        """How many window transfers the current sample set timed."""
+        return len(self._staging_samples)
+
+
 class _AnchoredBase(Fragmenter):
     def __init__(self, params: AnchoredCdcParams | None = None) -> None:
         self.params = params or AnchoredCdcParams()
@@ -214,7 +258,7 @@ class AnchoredCpuFragmenter(_AnchoredBase):
         return self._manifest_via_chunks_stream(blocks, name, store)
 
 
-class AnchoredTpuFragmenter(_AnchoredBase):
+class AnchoredTpuFragmenter(_StagingMeter, _AnchoredBase):
     """Device pipeline, region-batched; output is batching-independent."""
 
     name = "cdc-anchored-tpu"
@@ -252,21 +296,10 @@ class AnchoredTpuFragmenter(_AnchoredBase):
         # serialization) and only overlaps while the link has proven
         # faster than ``overlap_min_bw``; in overlapped mode every 8th
         # window is re-measured so a degrading link flips the walk back
-        # to serial within one region batch.
-        self.overlap_min_bw = float(overlap_min_bw)
-        self._staging_bw: float | None = None
-        self._since_measure = _REMEASURE_EVERY  # first window measures
-        # (bytes, seconds) of recent measured window transfers — the
-        # walk's own record of the link it actually had, which is the
-        # only bandwidth number honestly comparable to its e2e rate on
-        # a tunnel that swings 50x on minute timescales (bench_e2e_stream
-        # reads this; see staging_observed_bw). Bounded: a long-lived
-        # node on a slow link measures every window forever, and a
-        # lifetime average would mix samples hours apart.
-        import collections
-
-        self._staging_samples: collections.deque[tuple[int, float]] = \
-            collections.deque(maxlen=64)
+        # to serial within one region batch. The (bytes, seconds) sample
+        # record + its public surface live in _StagingMeter (shared with
+        # the sharded anchored walk since round 15).
+        self._init_staging(overlap_min_bw)
         # warm the _touch jit once at construction (trace + a trivial
         # 1-element compile): the readiness probe's one-time cost must
         # never be billed to the first staging-bandwidth sample
@@ -384,30 +417,6 @@ class AnchoredTpuFragmenter(_AnchoredBase):
             if store is not None:
                 store(dg, fetch(off, ln).tobytes())
         return base + consumed
-
-    def staging_observed_bw(self) -> float | None:
-        """Aggregate bandwidth of the recent transfers the walk timed
-        (up to the deque bound — the same-run link number its e2e rate
-        is honestly comparable to); None before any walk. Scope the
-        aggregate to one run with :meth:`reset_staging_samples` before
-        it (as bench_e2e_stream does)."""
-        if not self._staging_samples:
-            return None
-        return (sum(b for b, _ in self._staging_samples)
-                / sum(t for _, t in self._staging_samples))
-
-    def reset_staging_samples(self) -> int:
-        """Forget the recorded window-transfer timings (scoping the next
-        :meth:`staging_observed_bw` aggregate to the next run); returns
-        how many samples were dropped. The public face of the private
-        deque — benches must not reach into ``_staging_samples``."""
-        n = len(self._staging_samples)
-        self._staging_samples.clear()
-        return n
-
-    def staging_timed_windows(self) -> int:
-        """How many window transfers the current sample set timed."""
-        return len(self._staging_samples)
 
     def _walk(self, arr: np.ndarray, store=None) -> list[ChunkRef]:
         n = int(arr.shape[0])

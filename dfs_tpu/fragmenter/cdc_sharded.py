@@ -24,13 +24,13 @@ path — a degraded environment must not fail ingest.
 
 from __future__ import annotations
 
-import logging
-
 import numpy as np
 
 from dfs_tpu.config import GEAR_HALO as HALO
 from dfs_tpu.config import CDCParams, FragmenterConfig
 from dfs_tpu.fragmenter.cdc_cpu import CpuCdcFragmenter
+from dfs_tpu.fragmenter.sharded_common import (ShardedSteps,
+                                               fixed_region_bytes)
 from dfs_tpu.meta.manifest import Manifest
 
 
@@ -44,40 +44,31 @@ class ShardedCdcFragmenter(CpuCdcFragmenter):
         super().__init__(params)
         frag = frag or FragmenterConfig(devices=2)
         self.devices = max(2, int(frag.devices))
-        rb = frag.region_bytes or self.devices * (1 << 20)
-        # per-device spans must be equal (static shapes) and long enough
-        # to source the 31-value ring halo from their own tile
-        self.region_bytes = max(self.devices * 4 * HALO,
-                                rb // self.devices * self.devices)
-        self._step = None        # lazy: jax untouched until first stream
-        self._mesh = None
-        self._unavailable = False
+        # compile-shape policy (sharded_common): per-device spans must be
+        # equal (static shapes) and long enough to source the 31-value
+        # ring halo from their own tile -> granule = devices bytes,
+        # floor = devices * 4 * HALO
+        self.region_bytes = max(
+            self.devices * 4 * HALO,
+            fixed_region_bytes(frag.region_bytes,
+                               self.devices * (1 << 20), self.devices))
+        self._steps = ShardedSteps(self.devices, self._build)
 
     # ---- device plumbing ----
 
+    def _build(self, mesh):
+        from dfs_tpu.parallel.sharded_cdc import make_sharded_bitmap_step
+
+        return make_sharded_bitmap_step(mesh, self.table, self.params.mask)
+
+    @property
+    def _unavailable(self) -> bool:
+        """Degraded-environment flag (tests pin it) — the single
+        fallback predicate lives in sharded_common.ShardedSteps."""
+        return self._steps.unavailable
+
     def _ensure_step(self):
-        if self._step is not None or self._unavailable:
-            return self._step
-        try:
-            import jax
-
-            from dfs_tpu.parallel.mesh import make_mesh
-            from dfs_tpu.parallel.sharded_cdc import \
-                make_sharded_bitmap_step
-
-            if len(jax.devices()) < self.devices:
-                raise RuntimeError(
-                    f"{self.devices} devices configured, "
-                    f"{len(jax.devices())} visible")
-            # dp=1: one stream, its byte axis tiled over every device
-            self._mesh = make_mesh(self.devices, dp=1)
-            self._step = make_sharded_bitmap_step(
-                self._mesh, self.table, self.params.mask)
-        except Exception as e:  # noqa: BLE001 - degrade, don't fail ingest
-            self._unavailable = True
-            logging.getLogger("dfs_tpu.fragmenter").warning(
-                "sharded CDC unavailable (%s); running single-device", e)
-        return self._step
+        return self._steps.get()
 
     # ---- the substituted kernel ----
 
@@ -94,7 +85,7 @@ class ShardedCdcFragmenter(CpuCdcFragmenter):
         from dfs_tpu.parallel.sharded_cdc import shard_bitmap_inputs
 
         data, head = shard_bitmap_inputs(
-            self._mesh, np.ascontiguousarray(arr)[None, :],
+            self._steps.mesh, np.ascontiguousarray(arr)[None, :],
             np.ascontiguousarray(prev_g)[None, :])
         bitmap = np.asarray(jax.block_until_ready(step(data, head)))[0]
         # next region's carry halo: Gear table values of the last

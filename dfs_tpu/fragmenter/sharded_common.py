@@ -1,0 +1,77 @@
+"""Shared plumbing of the multi-device sharded fragmenters (round 15).
+
+Both sharded strategies — the ROLLING ``cdc`` one (cdc_sharded.py, r10)
+and the flagship ANCHORED one (cdc_anchored_sharded.py, r15) — need the
+same two pieces, and they must not drift apart:
+
+- **one compile-shape policy** (:func:`fixed_region_bytes`): streaming
+  input is re-blocked to a FIXED region size so the sharded step
+  traces/compiles exactly once for the whole stream. The size must be a
+  multiple of the strategy's per-device granule (so static per-device
+  spans tile it evenly) and at least a strategy-specific floor (the
+  rolling halo source span / the anchored two-segment window).
+
+- **one degraded-fallback predicate** (:class:`ShardedSteps`): building
+  the mesh + steps is LAZY (jax untouched until the first stream) and
+  any failure — jax missing, fewer devices visible than configured, a
+  backend that refuses the mesh — degrades to the single-device kernel
+  with one logged warning. A degraded environment must never fail
+  ingest; output is identical either way (the sharded steps compute the
+  same boundaries, which tests pin byte-identical).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+
+def fixed_region_bytes(requested: int, default: int, granule: int) -> int:
+    """The single compile-shape policy: the fixed per-stream region size
+    in bytes — ``requested`` (or ``default`` when 0) floored to a whole
+    multiple of ``granule``, never below one granule. Every region of a
+    stream except the ragged tail has exactly this size, so the sharded
+    step compiles once."""
+    rb = int(requested) or int(default)
+    return max(int(granule), rb // int(granule) * int(granule))
+
+
+class ShardedSteps:
+    """Lazy mesh + step construction behind the single fallback
+    predicate. ``build(mesh)`` runs at most once, on the first
+    :meth:`get`; it may return any strategy-specific step bundle.
+    Failure of any kind marks the instance unavailable, logs one
+    warning, and every later ``get()`` returns None — callers fall back
+    to their single-device kernel."""
+
+    def __init__(self, devices: int, build: Callable, dp: int = 1) -> None:
+        self.devices = int(devices)
+        self._build = build
+        self._dp = int(dp)
+        self._steps = None
+        self.mesh = None
+        self.unavailable = False
+
+    def get(self):
+        if self._steps is not None or self.unavailable:
+            return self._steps
+        try:
+            import jax
+
+            from dfs_tpu.parallel.mesh import make_mesh
+
+            if len(jax.devices()) < self.devices:
+                raise RuntimeError(
+                    f"{self.devices} devices configured, "
+                    f"{len(jax.devices())} visible")
+            # dp=1: one stream, its byte axis tiled over every device
+            # (the rolling halo ring); dp=devices: windows ride the dp
+            # axis, one whole window per device (the anchored walk)
+            self.mesh = make_mesh(self.devices, dp=self._dp)
+            self._steps = self._build(self.mesh)
+        except Exception as e:  # noqa: BLE001 - degrade, don't fail ingest
+            self.unavailable = True
+            self.mesh = None
+            logging.getLogger("dfs_tpu.fragmenter").warning(
+                "sharded CDC unavailable (%s); running single-device", e)
+        return self._steps

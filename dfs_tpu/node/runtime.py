@@ -2415,6 +2415,24 @@ class StorageNodeServer:
                 "stalls": self.ingest_stalls.snapshot(),
                 "cas": self.cas.stats()}
 
+    def frag_stats(self) -> dict:
+        """Fragmenter execution knobs for /metrics "frag" (DFS005: every
+        FragmenterConfig field surfaces here) plus what is ACTUALLY
+        running: the live engine name (the auto fragmenter can flip
+        CPU<->TPU mid-life) and ``degraded`` — True once a sharded walk
+        has fallen back to its single-device kernel (thin environment).
+        The sharded fragmenters share the host engine's ``name`` on
+        purpose (same strategy, same manifests), so the name alone
+        cannot reveal that fallback — this flag is the operator's
+        signal."""
+        f = self.cfg.frag
+        return {"devices": f.devices,
+                "regionBytes": f.region_bytes,
+                "stagingBuffers": f.staging_buffers,
+                "engine": self.fragmenter.name,
+                "degraded": bool(getattr(self.fragmenter,
+                                         "_unavailable", False))}
+
     async def trace_spans(self, trace_id: str,
                           cluster: bool = True) -> dict:
         """Spans of one trace — local ring, plus (``cluster=True``) every

@@ -448,6 +448,38 @@ def make_descriptor_fn(params: AnchoredCdcParams, cap: int, s_pad: int):
     return run
 
 
+def lane_tables_np(bounds, start0: int, s_pad: int):
+    """Host-side pass-B lane tables for ONE region from its segment
+    bounds — the NumPy mirror of :func:`make_descriptor_fn`'s encoding
+    (word floor + 2 lookback words, ``8*(start%4)`` funnel shift,
+    ceil-div block counts, tail lengths), padded to ``s_pad`` lanes.
+    The single implementation for every host caller (the sharded ingest
+    walk per window, ``parallel/sharded_cdc.host_lane_descriptors`` for
+    whole-stream oracles) so the layout cannot drift from the device
+    side. Returns ``(starts, seg_lens, w_off, sh8, real_blocks,
+    tail_len)``, each ``[s_pad]`` (``sh8`` u32, the rest i32)."""
+    bounds = np.asarray(bounds, dtype=np.int64)
+    nseg = int(bounds.shape[0])
+    if nseg > s_pad:
+        raise ValueError(f"{nseg} segments > lane table {s_pad}")
+    starts = np.zeros((s_pad,), np.int32)
+    seg_lens = np.zeros((s_pad,), np.int32)
+    w_off = np.zeros((s_pad,), np.int32)
+    sh8 = np.zeros((s_pad,), np.uint32)
+    real_blocks = np.zeros((s_pad,), np.int32)
+    tail_len = np.zeros((s_pad,), np.int32)
+    if nseg:
+        st = np.concatenate([[int(start0)], bounds[:-1]])
+        lens = bounds - st
+        starts[:nseg] = st
+        seg_lens[:nseg] = lens
+        w_off[:nseg] = st // 4 + 2       # +2: the 8 lookback bytes
+        sh8[:nseg] = (st % 4) * 8
+        real_blocks[:nseg] = -(-lens // BLOCK)
+        tail_len[:nseg] = lens % BLOCK
+    return starts, seg_lens, w_off, sh8, real_blocks, tail_len
+
+
 # ---------------------------------------------------------------------------
 # device pass B: repack segments into lanes + aligned chunk/hash
 # ---------------------------------------------------------------------------

@@ -317,28 +317,135 @@ def make_anchored_step(mesh: Mesh, params):
     return jax.jit(shard_fn)
 
 
-def shard_anchored_inputs(mesh: Mesh, words: np.ndarray, w_off: np.ndarray,
-                          sh8: np.ndarray, real_blocks: np.ndarray):
-    """device_put anchored pass-B inputs: words replicated, lane
-    descriptor arrays sharded over the flattened mesh."""
+def shard_anchored_lane_inputs(mesh: Mesh, w_off: np.ndarray,
+                               sh8: np.ndarray, real_blocks: np.ndarray):
+    """device_put ONLY the pass-B lane descriptor arrays (sharded over
+    the flattened mesh) — for callers whose region words are already
+    device-resident (the sharded anchored streaming walk stages the
+    region once per window and derives the lane tables after pass A)."""
     lane = NamedSharding(mesh, P(("dp", "sp")))
     return (
-        jax.device_put(words, NamedSharding(mesh, P())),
         jax.device_put(w_off, lane),
         jax.device_put(sh8, lane),
         jax.device_put(real_blocks, lane),
     )
 
 
+def shard_anchored_inputs(mesh: Mesh, words: np.ndarray, w_off: np.ndarray,
+                          sh8: np.ndarray, real_blocks: np.ndarray):
+    """device_put anchored pass-B inputs: words replicated, lane
+    descriptor arrays sharded over the flattened mesh."""
+    return (
+        jax.device_put(words, NamedSharding(mesh, P())),
+        *shard_anchored_lane_inputs(mesh, w_off, sh8, real_blocks),
+    )
+
+
+def make_anchored_window_anchor_step(mesh: Mesh, params, m_words: int):
+    """Window-BATCHED pass A of the anchored ingest walk (round 15):
+    ``dp_size`` stream windows ride the mesh's dp axis, each device
+    running the whole anchor pass (``ops.cdc_anchored.make_anchor_fn``
+    — the single definition, same as the span-sharded
+    :func:`make_anchored_anchor_step`) over its OWN window's region
+    buffer. No halo, no collective: the 8-byte lookback is baked into
+    each window's buffer host-side exactly as the single-device walk
+    bakes it.
+
+    Why windows-over-dp instead of spans-over-the-mesh: the ingest
+    walk's scaling axis must match its pass-B step (below), and pass B
+    is a SEQUENTIAL block scan whose wall-clock is chain-length-bound —
+    sharding one window's lanes across devices thins the vectors
+    without shortening the chain (measured near-FLAT, ~1.2x at 4
+    virtual devices), while running whole windows per device scales
+    throughput with the device count (3.85x resident at 4 — the
+    CDC_SHARD_r15.json A/B).
+
+    step(words [B, total_words] u32 — B == dp size, rows sharded over
+    dp, replicated over sp) -> tiles [B, 2, m_tiles] i32 (per-window
+    first-two-anchor tables, window-local positions)."""
+    from dfs_tpu.ops.cdc_anchored import make_anchor_fn
+
+    local_fn = make_anchor_fn(params, m_words)
+
+    def local_step(words):
+        return local_fn(words[0])[None]
+
+    shard_fn = _shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P("dp", None),),
+        out_specs=P("dp", None, None),
+        check_vma=False,
+    )
+    return jax.jit(shard_fn)
+
+
+def make_anchored_window_step(mesh: Mesh, params, total_words: int,
+                              s_pad: int):
+    """Window-BATCHED pass B, INGEST edition (round 15): each device
+    runs the whole single-device segment chain — Pallas/XLA repack,
+    fused candidates/selection/SHA strip scan, cut compaction, on-device
+    FIPS tail finalize (``ops.cdc_anchored.make_anchored_segment_fn`` —
+    the ONE definition of that math) — on its OWN stream window,
+    returning FINISHED (offset, length, digest) chunk tables. Pass A +
+    host segment selection (the carry-threaded ``select_segments``)
+    decide each window's lane tables; zero collectives on the data path.
+
+    Two measured dead ends picked this shape (CDC_SHARD_r15.json A/Bs,
+    96 MiB stream, 4 virtual devices):
+
+    - pulling only cutflags (:func:`make_anchored_step` with the SHA
+      outputs dropped) and hashing payloads on the host: 1.02x — the
+      serial host SHA dominated;
+    - sharding one window's segment LANES across the mesh with device
+      SHA: 1.28x — the strip scan is SEQUENTIAL over blocks, so
+      per-device wall time barely moves when only the lane axis thins
+      (the resident step alone measured ~1.2x).
+
+    Windows are independent given their carry, and the carry needs only
+    pass A + host select — so windows ride dp, and throughput scales
+    with devices (3.85x resident at 4) while each window's chain keeps
+    its single-device latency.
+
+    step(words [B, total_words] u32 — B == dp size, rows over dp,
+         w_off/sh8/real_blocks/tail_len/starts/seg_lens [B, s_pad] i32/
+         u32 — same row sharding)
+      -> (count [B] i32, q [B, c_max] i32, offs [B, c_max] i32,
+          lens [B, c_max] i32, digests [B, c_max, 8] u32)
+    — row b is window b's chunk table in stream order.
+    ``cap_mode='full'`` (capacities bound the worst case — a streaming
+    walk must never need the synchronous overflow redo)."""
+    from dfs_tpu.ops.cdc_anchored import make_anchored_segment_fn
+
+    segfn = make_anchored_segment_fn(params, total_words, s_pad,
+                                     cap_mode="full")
+
+    def local_step(words, w_off, sh8, real_blocks, tail_len, starts,
+                   seg_lens):
+        count, q, offs, lens, dig = segfn(
+            words[0], w_off[0], sh8[0], real_blocks[0], tail_len[0],
+            starts[0], seg_lens[0])
+        return (count[None], q[None], offs[None], lens[None], dig[None])
+
+    row = P("dp", None)
+    shard_fn = _shard_map(
+        local_step, mesh=mesh,
+        in_specs=(row, row, row, row, row, row, row),
+        out_specs=(P("dp"), row, row, row, P("dp", None, None)),
+        check_vma=False,
+    )
+    return jax.jit(shard_fn)
+
+
 def host_lane_descriptors(data: np.ndarray, params, pad_multiple: int):
     """Host-side segment selection + pass-B lane descriptor encoding for
-    a whole stream — ONE implementation of the w_off/sh8/real_blocks
-    layout (it must stay bit-identical to the device-side
-    make_descriptor_fn), shared by the dryrun parity check and the
-    multihost test worker. Returns (starts, bounds, seg_lens, w_off, sh8,
-    real_blocks, s_real)."""
-    from dfs_tpu.ops.cdc_anchored import kept_anchors_np, select_segments
-    from dfs_tpu.ops.cdc_v2 import BLOCK
+    a whole stream, shared by the dryrun parity check and the multihost
+    test worker. The w_off/sh8/real_blocks layout itself comes from
+    ``ops.cdc_anchored.lane_tables_np`` — the ONE host-side mirror of
+    the device-side make_descriptor_fn encoding (the sharded ingest
+    walk uses the same function per window). Returns (starts, bounds,
+    seg_lens, w_off, sh8, real_blocks, s_real)."""
+    from dfs_tpu.ops.cdc_anchored import (kept_anchors_np, lane_tables_np,
+                                          select_segments)
 
     n = int(data.shape[0])
     bounds = select_segments(kept_anchors_np(data, params), n, params)
@@ -346,12 +453,7 @@ def host_lane_descriptors(data: np.ndarray, params, pad_multiple: int):
     seg_lens = bounds - starts
     s_real = starts.shape[0]
     s_pad = -(-s_real // pad_multiple) * pad_multiple
-    w_off = np.zeros((s_pad,), np.int32)
-    sh8 = np.zeros((s_pad,), np.uint32)
-    real_blocks = np.zeros((s_pad,), np.int32)
-    w_off[:s_real] = starts // 4 + 2       # +2: the 8 lookback bytes
-    sh8[:s_real] = (starts % 4) * 8
-    real_blocks[:s_real] = -(-seg_lens // BLOCK)
+    _, _, w_off, sh8, real_blocks, _ = lane_tables_np(bounds, 0, s_pad)
     return starts, bounds, seg_lens, w_off, sh8, real_blocks, s_real
 
 

@@ -314,6 +314,11 @@ _CENSUS_METRIC_KEYS = {"history_interval_s": "historyIntervalS",
                        "history_coarse_every": "coarseEvery",
                        "history_coarse_slots": "coarseSlots",
                        "max_listed": "maxListed"}
+# fragmenter execution knobs surface under /metrics "frag"
+# (node/runtime.py frag_stats())
+_FRAG_METRIC_KEYS = {"devices": "devices",
+                     "region_bytes": "regionBytes",
+                     "staging_buffers": "stagingBuffers"}
 # durability mode surfaces under /metrics "durability"
 # (node/runtime.py durability_stats())
 _DURABILITY_METRIC_KEYS = {"mode": "mode"}
@@ -491,6 +496,8 @@ def check_config_drift(project: Project) -> Iterator[Finding]:
             (runtime, "ingest_stats", "IngestConfig", _INGEST_METRIC_KEYS),
             (serve_pkg, "stats", "ServeConfig", _SERVE_METRIC_KEYS),
             (obs_pkg, "stats", "ObsConfig", _OBS_METRIC_KEYS),
+            (runtime, "frag_stats", "FragmenterConfig",
+             _FRAG_METRIC_KEYS),
             (runtime, "census_stats", "CensusConfig",
              _CENSUS_METRIC_KEYS),
             (runtime, "durability_stats", "DurabilityConfig",

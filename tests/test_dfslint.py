@@ -343,6 +343,58 @@ def test_dfs005_census_fields_checked(tmp_path):
                            "dfs_tpu/node/runtime.py": runtime_ok}) == []
 
 
+def test_dfs005_frag_fields_checked(tmp_path):
+    """r15: FragmenterConfig rides all three DFS005 edges — a sharding
+    knob dropped from cmd_serve's constructor, and one whose /metrics
+    key vanishes from frag_stats(), must both be findings; the wired
+    fixture must be clean. (staging_buffers is the r15 field this
+    drift-gate exists for.)"""
+    cfg = (
+        "import dataclasses\n"
+        "@dataclasses.dataclass(frozen=True)\n"
+        "class FragmenterConfig:\n"
+        "    devices: int = 0\n"
+        "    staging_buffers: int = 2\n")
+    cli_missing = (
+        "from dfs_tpu.config import FragmenterConfig\n"
+        "def cmd_serve(args):\n"
+        "    return FragmenterConfig(devices=args.cdc_devices)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--cdc-devices', type=int, default=0)\n")
+    runtime_ok = (
+        "class S:\n"
+        "    def frag_stats(self):\n"
+        "        return {'devices': 0, 'stagingBuffers': 2}\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/cli/main.py": cli_missing,
+                            "dfs_tpu/node/runtime.py": runtime_ok})
+    assert rules_of(found) == ["DFS005"]
+    assert "FragmenterConfig.staging_buffers" in found[0].message
+
+    cli_ok = (
+        "from dfs_tpu.config import FragmenterConfig\n"
+        "def cmd_serve(args):\n"
+        "    return FragmenterConfig(devices=args.cdc_devices,\n"
+        "                            staging_buffers=args.cdc_staging)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--cdc-devices', type=int, default=0)\n"
+        "    sub.add_argument('--cdc-staging', type=int, default=2)\n")
+    runtime_missing_key = (
+        "class S:\n"
+        "    def frag_stats(self):\n"
+        "        return {'devices': 0}\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/cli/main.py": cli_ok,
+                            "dfs_tpu/node/runtime.py":
+                            runtime_missing_key})
+    assert rules_of(found) == ["DFS005"]
+    assert "stagingBuffers" in found[0].message
+
+    assert lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                           "dfs_tpu/cli/main.py": cli_ok,
+                           "dfs_tpu/node/runtime.py": runtime_ok}) == []
+
+
 def test_dfs005_chaos_fields_checked(tmp_path):
     """r13: ChaosConfig rides the same three DFS005 edges — a chaos
     knob dropped from cmd_serve's constructor, and one whose /metrics

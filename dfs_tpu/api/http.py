@@ -359,6 +359,9 @@ async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
         snap["retryBudget"] = node.client.retry_budget.stats()
         snap["ring"] = node.ring_stats()  # membership epoch + rebalance
         # progress (r14, additive like "obs"/"census")
+        snap["index"] = node.index_stats()  # dedup/index plane: LSI
+        # gauges + filter bytes + probe-skip counters (r16, additive);
+        # {"enabled": false, ...config echo} on a plane-less node
         return as_json(200, snap)
 
     if method == "GET" and path == "/metrics/history":

@@ -19,8 +19,9 @@ from pathlib import Path
 from dfs_tpu.cli.client import NodeClient
 from dfs_tpu.config import (CDCParams, CensusConfig, ChaosConfig,
                             ClusterConfig, DurabilityConfig,
-                            FragmenterConfig, IngestConfig, NodeConfig,
-                            ObsConfig, RingConfig, ServeConfig)
+                            FragmenterConfig, IndexConfig, IngestConfig,
+                            NodeConfig, ObsConfig, RingConfig,
+                            ServeConfig)
 
 
 def _client(args) -> NodeClient:
@@ -87,6 +88,12 @@ def cmd_serve(args) -> int:
             vnodes=args.ring_vnodes,
             members=args.ring_members,
             rebalance_credit_bytes=args.ring_rebalance_credit_bytes),
+        index=IndexConfig(
+            enabled=args.index,
+            memtable_entries=args.index_memtable_entries,
+            compact_runs=args.index_compact_runs,
+            filter_bits_per_key=args.index_filter_bits,
+            filter_sync_s=args.index_filter_sync),
         chaos=ChaosConfig(
             enabled=args.chaos,
             seed=args.chaos_seed,
@@ -629,6 +636,26 @@ def build_parser() -> argparse.ArgumentParser:
                        help="online-rebalancer bandwidth bound "
                             "(payload bytes/s per node); 0 = "
                             "unthrottled")
+    serve.add_argument("--index", action="store_true",
+                       help="enable the dedup/index plane "
+                            "(docs/index.md): persistent log-"
+                            "structured digest index + peer-existence "
+                            "filters; without this flag local "
+                            "existence stays one stat per digest and "
+                            "placement probes every digest over RPC")
+    serve.add_argument("--index-memtable-entries", type=int,
+                       default=65536,
+                       help="in-memory index entries before a flush "
+                            "to a sorted on-disk run")
+    serve.add_argument("--index-compact-runs", type=int, default=4,
+                       help="sorted runs before a full compaction "
+                            "folds them into one")
+    serve.add_argument("--index-filter-bits", type=int, default=10,
+                       help="peer-existence filter bloom bits per "
+                            "key; 0 = no filters (local index only)")
+    serve.add_argument("--index-filter-sync", type=float, default=5.0,
+                       help="peer-filter gossip cadence (s); 0 = no "
+                            "background filter exchange")
     serve.add_argument("--chaos", action="store_true",
                        help="enable the fault-injection plane "
                             "(docs/chaos.md): the knobs below apply "

@@ -603,6 +603,31 @@ class InternalClient:
         census = resp.get("census")
         return census if isinstance(census, dict) else None
 
+    async def get_filter(self, peer: PeerAddr,
+                         retries: int | None = None
+                         ) -> tuple[dict | None, memoryview]:
+        """Full peer-existence filter snapshot (docs/index.md):
+        (meta, filter-bytes view) — meta None when the peer runs no
+        filter plane (pre-r16 build or filters off). The body view is
+        zero-copy; callers that retain the filter past the reply frame
+        copy explicitly (runtime ``_filter_fetch_full``)."""
+        resp, body = await self.call(peer, {"op": "get_filter"},
+                                     retries=retries)
+        meta = resp.get("filter")
+        return (meta if isinstance(meta, dict) else None), body
+
+    async def filter_delta(self, peer: PeerAddr, gen: int, since: int,
+                           retries: int | None = None) -> dict:
+        """Incremental filter update from (generation, version): the
+        reply carries ``adds`` (digests since ``since``) or
+        ``resync: true`` when the replica must refetch the full filter
+        — generation moved, unknown cursor, or the peer's add log no
+        longer reaches back (at-least-once, like propose_ring)."""
+        resp, _ = await self.call(
+            peer, {"op": "filter_delta", "gen": gen, "since": since},
+            retries=retries)
+        return resp
+
     async def get_manifest(self, peer: PeerAddr, file_id: str
                            ) -> tuple[str | None, float | None]:
         """-> (manifest json or None, origin mtime or None). The mtime is

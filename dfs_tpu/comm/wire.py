@@ -39,6 +39,16 @@ Round 10 makes the frame layer **zero-copy** (docs/wire.md):
   every body) — and :func:`unpack_chunks` hands out read-only
   memoryview slices of it instead of per-chunk copies.
 
+Round 16 adds two dedup/index-plane metadata ops (docs/index.md),
+carried in the same frame shape: ``get_filter`` replies with the
+peer-existence filter meta in the header and the raw blocked-bloom
+bytes as the body (a binary payload like chunk data — never Base64),
+and ``filter_delta`` replies header-only with the digests added since
+a (generation, version) cursor or ``resync: true``. Both are optional:
+peers that predate the ops answer "unknown op", which the filter sync
+loop treats as "no filter plane" — compatibility is bidirectional like
+the ``trace`` field.
+
 The stream-based :func:`send_msg` / :func:`read_msg` remain the
 compatibility surface (tests, tooling, pre-r10 interop): the bytes on
 the wire are identical.

@@ -455,6 +455,52 @@ class RingConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class IndexConfig:
+    """Scalable dedup/index plane (dfs_tpu.index, docs/index.md):
+    persistent log-structured digest index + delta-gossiped
+    peer-existence filters.
+
+    EVERYTHING defaults off: ``IndexConfig()`` builds no index and no
+    filters — ``ChunkStore.has`` stays one stat syscall, placement
+    probes every digest over RPC, and the node runs byte-identical
+    code paths to a pre-index build (the chaos/serve default-off
+    discipline, asserted by tests/test_index.py). ``enabled=True``
+    builds the :class:`~dfs_tpu.index.IndexPlane`:
+
+    - local existence answers come from the log-structured index (one
+      memtable hit or one fenced ``pread``), with the stat call kept
+      as the negative-confirmation backstop;
+    - each node maintains a blocked-bloom filter over its own digest
+      set (``filter_bits_per_key`` sizes it; 0 = index only, no
+      filter exchange), replicated to peers via ``get_filter`` /
+      ``filter_delta`` every ``filter_sync_s`` seconds;
+    - placement consults the peer filters first and only RPCs what
+      the filters cannot rule out, with filter-credited copies
+      verified by one pre-ack ``has_chunks`` round (docs/index.md).
+    """
+
+    enabled: bool = False
+    memtable_entries: int = 65536   # bounded in-memory index entries
+                                    # before a flush to a sorted run
+    compact_runs: int = 4           # sorted runs before a full
+                                    # compaction folds them into one
+    filter_bits_per_key: int = 10   # peer-filter bloom density;
+                                    # 0 = no filters (index only)
+    filter_sync_s: float = 5.0      # filter gossip cadence (s);
+                                    # 0 = no background exchange
+
+    def __post_init__(self) -> None:
+        if self.memtable_entries < 256:
+            raise ValueError("memtable_entries must be >= 256")
+        if self.compact_runs < 1:
+            raise ValueError("compact_runs must be >= 1")
+        if self.filter_bits_per_key < 0:
+            raise ValueError("filter_bits_per_key must be >= 0")
+        if self.filter_sync_s < 0:
+            raise ValueError("filter_sync_s must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
 class IngestConfig:
     """Pipelined write path (docs/ingest.md) — the knobs bounding how much
     of the three-stage ingest pipeline (fragmentation, local CAS writes,
@@ -549,6 +595,10 @@ class NodeConfig:
     # compiles the boot peer list into a static epoch-0 ring whose
     # placement is byte-identical to the pre-r14 cyclic replica sets
     ring: RingConfig = dataclasses.field(default_factory=RingConfig)
+    # dedup/index plane (dfs_tpu.index): the default IndexConfig()
+    # builds NO index and NO filters — local existence stays one stat,
+    # placement probes every digest over RPC (pre-r16 paths exactly)
+    index: IndexConfig = dataclasses.field(default_factory=IndexConfig)
 
     @property
     def self_addr(self) -> PeerAddr:

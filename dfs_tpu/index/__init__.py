@@ -1,0 +1,112 @@
+"""Scalable dedup/index plane (docs/index.md, ROADMAP item 2).
+
+Two halves, both default-off behind :class:`~dfs_tpu.config.IndexConfig`:
+
+- :mod:`dfs_tpu.index.lsi` — the persistent log-structured local digest
+  index: a memory-bounded on-disk fingerprint catalog so local
+  existence probes stop being one stat syscall per digest (Zhu et al.,
+  FAST'08's disk-bottleneck fix, scaled to this node's CAS);
+- :mod:`dfs_tpu.index.filter` — blocked-bloom summaries of each peer's
+  digest set, delta-gossiped over the storage plane, so placement can
+  skip most ``has_chunks`` probe round-trips.
+
+:class:`IndexPlane` is the node-facing assembly: the runtime builds one
+when ``IndexConfig.enabled`` and hands it to the :class:`ChunkStore`
+(the ``index`` seam — put/delete feed + the ``has()`` fast path). A
+zero-knob node builds NO plane and every seam is one ``is None`` branch
+(the chaos/serve default-off discipline, asserted by
+tests/test_index.py).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from dfs_tpu.index.filter import (DELTA_CAP, BlockedBloomFilter,
+                                  LocalFilter, PeerFilterSet)
+from dfs_tpu.index.lsi import DigestIndex
+
+# run-internal bloom sizing (per-run skip filters inside the LSI) —
+# deliberately NOT the peer-filter knob: the peer exchange can be off
+# (filter_bits_per_key=0) while lookups still want run skipping
+_RUN_BLOOM_BITS = 10
+
+
+class IndexPlane:
+    """One node's dedup/index plane: LSI + local filter + peer-filter
+    replicas + the probe-skipping counters placement feeds.
+
+    The LSI feed methods (``note_put`` / ``note_delete`` / ``lookup``)
+    run on the bounded CAS worker threads (the ChunkStore seam); the
+    counters are event-loop-only (placement/probe paths)."""
+
+    def __init__(self, cfg, root: Path) -> None:
+        self.cfg = cfg
+        self.lsi = DigestIndex(
+            Path(root) / "index",
+            memtable_entries=cfg.memtable_entries,
+            compact_runs=cfg.compact_runs,
+            bloom_bits_per_key=_RUN_BLOOM_BITS)
+        self.local_filter: LocalFilter | None = None
+        self.peer_filters = PeerFilterSet()
+        if cfg.filter_bits_per_key > 0:
+            self.local_filter = LocalFilter(
+                bits_per_key=cfg.filter_bits_per_key)
+            self.lsi.on_compact = self.local_filter.rebuild
+        # placement probe-skipping accounting (event loop only)
+        self.probes_skipped = 0       # digests never probed over RPC
+        self.probe_rpcs_skipped = 0   # whole has_chunks RPCs elided
+        self.trusted = 0              # filter-positive copies credited
+
+    # ---- ChunkStore seam (CAS worker threads) ------------------------ #
+
+    def note_put(self, digest: str, defer_flush: bool = False) -> None:
+        self.lsi.note_put(digest, defer_flush=defer_flush)
+        if self.local_filter is not None:
+            self.local_filter.add(digest)
+
+    def note_delete(self, digest: str,
+                    defer_flush: bool = False) -> None:
+        self.lsi.note_delete(digest, defer_flush=defer_flush)
+        # blooms cannot unlearn: the delete stays a stale bit until the
+        # next compaction rebuilds the filter (fresh generation)
+
+    def maybe_flush(self) -> None:
+        """Deferred flush/compaction check (see DigestIndex.note_put):
+        the ChunkStore seam calls this AFTER releasing its ordering
+        mutex, so a merge never freezes every CAS worker behind it."""
+        self.lsi.maybe_flush()
+
+    def lookup(self, digest: str) -> bool:
+        return self.lsi.lookup(digest)
+
+    # ---- lifecycle --------------------------------------------------- #
+
+    def open_or_rebuild(self, cas_digests) -> dict:
+        info = self.lsi.open_or_rebuild(cas_digests)
+        if self.local_filter is not None and not info["rebuilt"]:
+            # prime the local filter from the opened index; the
+            # rebuild path already primed it via on_compact — doing it
+            # again would re-pay a full-catalog merge at boot
+            self.local_filter.rebuild(self.lsi.present_digests())
+        return info
+
+    def close(self) -> None:
+        self.lsi.close()
+
+    # ---- /metrics "index" (live half; config echo lives in runtime) -- #
+
+    def stats(self) -> dict:
+        out = {"lsi": self.lsi.stats(),
+               "probesSkipped": self.probes_skipped,
+               "probeRpcsSkipped": self.probe_rpcs_skipped,
+               "filterTrusted": self.trusted,
+               "filterFp": self.peer_filters.fp_observed}
+        if self.local_filter is not None:
+            out["filter"] = self.local_filter.stats()
+            out["peerFilters"] = self.peer_filters.stats()
+        return out
+
+
+__all__ = ["IndexPlane", "DigestIndex", "LocalFilter",
+           "BlockedBloomFilter", "PeerFilterSet", "DELTA_CAP"]

@@ -4,8 +4,9 @@ One process per node, two listeners:
 - external HTTP API (dfs_tpu.api.http) — /status /files /upload /download,
   capability parity with StorageNode.java:71-89;
 - internal binary storage plane (this module) — store_chunks / announce /
-  get_chunk / get_manifest / health / has_chunks, replacing the reference's
-  /internal/* HTTP+Base64 endpoints (StorageNode.java:92-105).
+  get_chunk / get_manifest / health / has_chunks (+ the r16 dedup/index
+  ops get_filter / filter_delta, docs/index.md), replacing the
+  reference's /internal/* HTTP+Base64 endpoints (StorageNode.java:92-105).
 
 Deliberate upgrades over the reference, per SURVEY.md §2.5 / §5.3:
 - write-quorum instead of write-all: the reference aborts the entire upload if
@@ -34,7 +35,8 @@ import types
 from collections import deque
 from typing import Mapping, Sequence
 
-from dfs_tpu.comm.rpc import InternalClient, RpcError, RpcUnreachable
+from dfs_tpu.comm.rpc import (InternalClient, RpcError, RpcRemoteError,
+                              RpcUnreachable)
 from dfs_tpu.comm.wire import (FrameServerProtocol, WireError, encode_frame,
                                pack_chunks, unpack_chunks)
 from dfs_tpu.config import NodeConfig
@@ -208,6 +210,29 @@ class ByteBudget:
             return self._out
 
 
+class _TrustLedger:
+    """Filter-credited replica copies awaiting pre-ack verification.
+
+    When placement trusts a peer-filter POSITIVE (skipping both the
+    has_chunks probe and the transfer — the re-upload fast path,
+    docs/index.md), the copy it credited is a bloom ``maybe``, not a
+    fact. Every trusted (peer, digest, length) lands here, and
+    ``StorageNodeServer._verify_trusted`` confirms the whole ledger
+    with ONE has_chunks round per peer BEFORE the manifest write acks
+    the upload — so a false positive can delay an ack (it gets healed
+    by a real transfer first), never weaken one. Event-loop-only, like
+    the placement bookkeeping it extends."""
+
+    def __init__(self) -> None:
+        self.by_peer: dict[int, dict[str, int]] = {}
+
+    def credit(self, peer: int, digest: str, length: int) -> None:
+        self.by_peer.setdefault(peer, {})[digest] = length
+
+    def __bool__(self) -> bool:
+        return bool(self.by_peer)
+
+
 def _config_fingerprint(cfg: NodeConfig) -> str:
     """sha256 over the SHARED config surface — everything that should be
     identical across a healthy cluster. Node-local identity fields
@@ -268,6 +293,25 @@ class StorageNodeServer:
             # worker threads, so ENOSPC/EIO/slow-disk injection covers
             # the AsyncChunkStore tier and every sync caller alike
             self.store.chunks.fault = self.chaos.store_hook()
+        # dedup/index plane (dfs_tpu.index, docs/index.md): None unless
+        # IndexConfig.enabled — a zero-knob node keeps the stat-per-
+        # digest existence paths byte-identical. Built after obs (the
+        # LSI journals index_rebuild/index_compact through it);
+        # OPENED in start(), before the servers listen. (The
+        # mid-compaction kill -9 coverage drives the DigestIndex.hook
+        # seam directly — tests/test_index.py, bench_dedup_index.py —
+        # rather than the CRASH_POINTS registry, whose every entry
+        # must fire on a default-config upload.)
+        self.index = None
+        self._filter_sync_task: asyncio.Task | None = None
+        if cfg.index.enabled:
+            from dfs_tpu.index import IndexPlane
+
+            self.index = IndexPlane(cfg.index, self.store.root)
+            self.index.lsi.on_event = self.obs.event
+            # the ChunkStore seam: every put/delete feeds the LSI from
+            # the CAS worker threads; has() answers from it first
+            self.store.chunks.index = self.index
         # elastic membership (dfs_tpu.ring, docs/membership.md): the
         # epoch-versioned placement map + migration window + rebalance
         # credits. Built after obs (epoch changes journal) and before
@@ -360,6 +404,20 @@ class StorageNodeServer:
         # crash-leaked temp file (all from the previous life) and run
         # the aged orphan GC, reconciling a crash between CAS put and
         # manifest write with the same path aborted streams already use
+        if self.index is not None:
+            # open (or rebuild from the CAS walk — the chunk files are
+            # ground truth) BEFORE the boot sweep and the servers: the
+            # sweep's orphan GC feeds deletes through the ChunkStore
+            # seam, and deletes noted into an UNOPENED index would be
+            # overwritten by the WAL replay — the swept chunks coming
+            # back as phantom "present" answers. Off the loop: a
+            # rebuild reads the whole catalog's names.
+            info = await asyncio.to_thread(self.index.open_or_rebuild,
+                                           self.store.chunks.digests)
+            if info["rebuilt"]:
+                self.log.warning("digest index rebuilt from CAS walk "
+                                 "(%d entries): %s", info["entries"],
+                                 info["reason"])
         swept = await asyncio.to_thread(self.store.boot_sweep)
         if swept["tmps"] or swept["orphans"]:
             self.obs.event("boot_sweep", **swept)
@@ -392,6 +450,14 @@ class StorageNodeServer:
             # Best-effort: the epoch-on-RPC gossip is the backstop.
             self._ring_catchup_task = create_logged_task(
                 self._ring_catchup(), self.log, "ring-catchup")
+        if self.index is not None \
+                and self.index.local_filter is not None \
+                and self.cfg.index.filter_sync_s > 0 and self._peers():
+            # peer-existence filter gossip (docs/index.md): replicate
+            # every peer's filter on the configured cadence — deltas
+            # when the generation holds, full resync when it moved
+            self._filter_sync_task = create_logged_task(
+                self._filter_sync_loop(), self.log, "filter-sync")
         # flight-recorder boot record: the config this life ran with is
         # the first question of every post-mortem
         self.obs.event("boot", configHash=self._config_hash,
@@ -407,11 +473,19 @@ class StorageNodeServer:
         if self._ring_catchup_task is not None:
             self._ring_catchup_task.cancel()
             self._ring_catchup_task = None
+        if self._filter_sync_task is not None:
+            self._filter_sync_task.cancel()
+            self._filter_sync_task = None
         if self.sentinel is not None:
             self.sentinel.stop()
         self.health.stop()
         self.client.close()   # drop pooled peer connections
         self.cas.close()      # async CAS tier workers (non-blocking)
+        if self.index is not None:
+            # flush the WAL buffer + close run fds; off the loop (file
+            # I/O). In-flight CAS jobs racing the close lose only
+            # buffered PUT records — the safe divergence direction.
+            await asyncio.to_thread(self.index.close)
         # Peers keep POOLED connections into this node open indefinitely;
         # Server.wait_closed() (3.12+) waits for every live handler, so
         # idle inbound connections must be torn down explicitly or stop()
@@ -594,6 +668,108 @@ class StorageNodeServer:
                 "active": self.ring.current.active_ids(),
                 "rebalance": self.ring.rebalance_stats()}
 
+    # ------------------------------------------------------------------ #
+    # dedup/index plane: filter gossip (dfs_tpu.index, docs/index.md)
+    # ------------------------------------------------------------------ #
+
+    async def _filter_sync_loop(self) -> None:
+        """Replicate every peer's existence filter on the configured
+        cadence (``IndexConfig.filter_sync_s``). The first round runs
+        immediately — a freshly-booted node should start skipping
+        probes as soon as its peers can be asked."""
+        interval = self.cfg.index.filter_sync_s
+        while True:
+            try:
+                await self._filter_sync_once()
+            except Exception as e:  # noqa: BLE001 — the sync loop must
+                # outlive one bad round; next tick retries
+                self.log.warning("filter sync failed: %s", e)
+            await asyncio.sleep(interval)
+
+    async def _filter_sync_once(self) -> int:
+        """One gossip round: per peer, a ``filter_delta`` from the
+        replicated (generation, version) cursor — or a full
+        ``get_filter`` resync when no replica exists yet, the
+        generation moved, or the delta is unusable/corrupt (strict
+        validation; at-least-once like propose_ring). Returns peers
+        successfully synced."""
+        plane = self.index
+        if plane is None or plane.local_filter is None:
+            return 0
+        synced = 0
+        for peer in self._peers():
+            st = plane.peer_filters.state(peer.node_id)
+            try:
+                if st is None:
+                    ok = await self._filter_fetch_full(peer)
+                else:
+                    resp = await self.client.filter_delta(
+                        peer, st["gen"], st["version"], retries=1)
+                    gen, version = resp.get("gen"), resp.get("version")
+                    ok = (not resp.get("resync")
+                          and isinstance(gen, int)
+                          and isinstance(version, int)
+                          and plane.peer_filters.apply_delta(
+                              peer.node_id, gen, version,
+                              resp.get("adds")))
+                    if not ok:
+                        # generation moved / corrupt or malformed
+                        # delta: the replica cannot be patched — full
+                        # resync, never a poisoned filter
+                        ok = await self._filter_fetch_full(peer)
+                if ok:
+                    synced += 1
+            # a LIVE peer that answers "unknown op" is a pre-r16 build
+            # (or filters off): there is nothing to sync from it and
+            # nothing is wrong — the probe path simply stays un-trimmed
+            # for that peer. Not silent: the absent replica is visible
+            # in /metrics index.peerFilters and the doctor's
+            # index_stale ages.
+            except RpcRemoteError:  # dfslint: ignore[DFS007]
+                continue
+            except RpcError:
+                # transport failure: best-effort by contract (the probe
+                # path degrades to probing); counted so habitual
+                # failures surface
+                self.counters.inc("filter_sync_failures")
+        return synced
+
+    async def _filter_fetch_full(self, peer) -> bool:
+        """Full filter resync from one peer; False = the peer runs no
+        filter plane (pre-r16 build or filters off) or sent garbage."""
+        plane = self.index
+        meta, body = await self.client.get_filter(peer, retries=1)
+        if meta is None:
+            return False
+        try:
+            # ownership copy ON PURPOSE: the replica outlives the reply
+            # frame, and pinning the receive buffer for the filter's
+            # lifetime would hold every frame it arrived in
+            plane.peer_filters.apply_full(
+                peer.node_id, meta, bytes(body))  # dfslint: ignore[DFS006]
+        except (KeyError, TypeError, ValueError):
+            self.counters.inc("filter_sync_failures")
+            return False
+        self.obs.event("filter_resync", peer=peer.node_id,
+                       gen=meta.get("gen"), bytes=len(body))
+        return True
+
+    def index_stats(self) -> dict:
+        """``/metrics`` ``index`` section. The enabled/memtableEntries/
+        compactRuns/filterBitsPerKey/filterSyncS keys mirror
+        IndexConfig fields (dfslint DFS005 checks the config ⇄ CLI ⇄
+        metrics mapping); the live plane (LSI gauges, filter bytes,
+        probe-skip counters) rides alongside when enabled."""
+        c = self.cfg.index
+        out = {"enabled": c.enabled,
+               "memtableEntries": c.memtable_entries,
+               "compactRuns": c.compact_runs,
+               "filterBitsPerKey": c.filter_bits_per_key,
+               "filterSyncS": c.filter_sync_s}
+        if self.index is not None:
+            out.update(self.index.stats())
+        return out
+
     async def _serve_internal_frame(self, conn, header: dict,
                                     body: memoryview,
                                     nbytes_in: int) -> None:
@@ -746,10 +922,40 @@ class StorageNodeServer:
             return {"ok": True, "digests": echoed}, b""
         if op == "has_chunks":
             digests = header.get("digests", [])
-            # tens of thousands of stat() calls — off the loop
-            have = await asyncio.to_thread(
-                lambda: [d for d in digests if self.store.chunks.has(d)])
-            return {"ok": True, "have": have}, b""
+            # ONE bounded read-pool job for the whole probe list (this
+            # used to ride the unbounded to_thread executor); with the
+            # index plane on, each answer is a memtable/run hit instead
+            # of a stat syscall — the hot probe service stops paying
+            # one filesystem touch per probed digest (docs/index.md)
+            mask = await self.cas.has_many(digests)
+            return {"ok": True,
+                    "have": [d for d, h in zip(digests, mask) if h]}, b""
+        if op == "get_filter":
+            # peer-existence filter replication (docs/index.md): the
+            # full filter snapshot — generation-stamped; cheap
+            # metadata, ungated like get_ring. `filter: null` = this
+            # node runs no filter plane (pre-r16 peer or filters off).
+            if self.index is None or self.index.local_filter is None:
+                return {"ok": True, "filter": None}, b""
+            meta, body = self.index.local_filter.snapshot()
+            return {"ok": True, "filter": meta}, body
+        if op == "filter_delta":
+            # incremental filter update: digests added since (gen,
+            # version), or resync=True when the caller must refetch the
+            # full filter — generation moved, version unknown, or the
+            # add log no longer reaches back (at-least-once discipline,
+            # same shape as propose_ring). Malformed cursors answer
+            # resync, never an error: gossip must converge, not fail.
+            if self.index is None or self.index.local_filter is None:
+                return {"ok": True, "resync": True, "gen": -1,
+                        "version": 0}, b""
+            gen, since = header.get("gen"), header.get("since")
+            if not isinstance(gen, int) or not isinstance(since, int) \
+                    or isinstance(gen, bool) or isinstance(since, bool):
+                return {"ok": True, "resync": True, "gen": -1,
+                        "version": 0}, b""
+            return {"ok": True,
+                    **self.index.local_filter.delta(gen, since)}, b""
         if op == "announce":
             m = Manifest.from_json(header["manifest"])
             if header.get("fresh"):
@@ -904,8 +1110,13 @@ class StorageNodeServer:
             stats["ecParityBytes"] = sum(len(b) for _, b in parity)
             placement = ec_placement_map(manifest, self.ring.current)
             rf = 1   # the parity IS the redundancy (any 2 shards may die)
+        ledger = self._new_trust_ledger()
         await self._place_batch(file_id, batch, stats, rf=rf,
-                                placement=placement)
+                                placement=placement, ledger=ledger)
+        if ledger:
+            # filter-credited copies confirmed BEFORE the ack
+            await self._verify_trusted(file_id, ledger, stats, rf=rf,
+                                       placement=placement)
         await self._finalize_upload(manifest)
         self.counters.inc("upload_bytes", len(data))
         return manifest, stats
@@ -1075,10 +1286,12 @@ class StorageNodeServer:
             inflight.popleft()
             self._merge_upload_stats(stats, bstats)
 
+        ledger = self._new_trust_ledger()
+
         async def submit(b: list[tuple[str, bytes]]) -> None:
             if window == 1:     # serial placement: the historical
                 # schedule, byte-identical behavior
-                await self._place_batch("", b, stats)
+                await self._place_batch("", b, stats, ledger=ledger)
                 return
             while len(inflight) >= window:
                 # stall attribution: the window is full — ingest is
@@ -1102,7 +1315,8 @@ class StorageNodeServer:
                 self.ingest_stalls.add("placementS",
                                        time.perf_counter() - t0)
             bstats = self._new_upload_stats()
-            task = asyncio.create_task(self._place_batch("", b, bstats))
+            task = asyncio.create_task(
+                self._place_batch("", b, bstats, ledger=ledger))
             # completion wakes the consume loop below via a sentinel: a
             # FAILED placement must abort the stream even while the
             # consumer is parked on outq behind a slow client — without
@@ -1181,6 +1395,11 @@ class StorageNodeServer:
                             chunks=manifest.chunks)
         stats["bytes"] = total
         stats["uniqueChunks"] = len(seen)
+        if ledger:
+            # every filter-credited copy across every placed batch is
+            # confirmed in ONE has_chunks round per peer — before the
+            # manifest write acks the stream (docs/index.md)
+            await self._verify_trusted(file_id, ledger, stats)
         await self._finalize_upload(manifest)
         self.counters.inc("upload_bytes", total)
         return manifest, stats
@@ -1188,10 +1407,19 @@ class StorageNodeServer:
     async def missing_digests(self, digests: list[str]) -> list[str]:
         """Which of ``digests`` the cluster holds NOwhere reachable —
         the resumable-upload probe (SURVEY §5.4: chunk-level resume falls
-        out of the dedup index). Local CAS first; the remainder is asked
-        of each digest's replica set via batched has_chunks."""
-        missing = [d for d in dict.fromkeys(digests)
-                   if is_hex_digest(d) and not self.store.chunks.has(d)]
+        out of the dedup index). Local CAS first — ONE batched
+        ``has_many`` job on the bounded read pool (this loop used to
+        stat inline ON the event loop, one syscall per digest); the
+        remainder is asked of each digest's replica set via batched
+        has_chunks, with peer-filter-ruled-out digests never probed at
+        all. Filter POSITIVES are still probed here on purpose: a
+        bloom false positive answered as "cluster has it" would tell
+        the client to skip bytes, and at bloom FP rates every large
+        resume would then trip upload_resume's 409 fallback — the
+        probe is cheaper than the fallback (docs/index.md)."""
+        cand = [d for d in dict.fromkeys(digests) if is_hex_digest(d)]
+        mask = await self.cas.has_many(cand)
+        missing = [d for d, h in zip(cand, mask) if not h]
         if not missing:
             return []
         rf = self.cfg.cluster.replication_factor
@@ -1203,6 +1431,22 @@ class StorageNodeServer:
             for t in self.ring.read_candidates(d, rf):
                 if t != self.cfg.node_id:
                     by_peer.setdefault(t, []).append(d)
+        plane = self.index
+        if plane is not None and plane.local_filter is not None:
+            trimmed: dict[int, list[str]] = {}
+            for nid, ds in by_peer.items():
+                if plane.peer_filters.state(nid) is None:
+                    trimmed[nid] = ds       # no replica: probe as-is
+                    continue
+                keep = [d for d in ds
+                        if plane.peer_filters.contains(nid, d)
+                        is not False]
+                plane.probes_skipped += len(ds) - len(keep)
+                if keep:
+                    trimmed[nid] = keep
+                elif ds:
+                    plane.probe_rpcs_skipped += 1
+            by_peer = trimmed
 
         async def probe(nid: int, ds: list[str]) -> None:
             try:
@@ -1267,6 +1511,7 @@ class StorageNodeServer:
         stats["bytes"] = sum(len(b) for b in provided.values())
         hasher = sha256_new()
         seen: set[str] = set()
+        ledger = self._new_trust_ledger()
         batch: list = []
         bsize = 0
         for c in refs:
@@ -1294,7 +1539,8 @@ class StorageNodeServer:
                 place = [(x.digest, got[x.digest]) for x in batch
                          if x.digest not in seen]
                 seen.update(d for d, _ in place)
-                await self._place_batch(file_id, place, stats)
+                await self._place_batch(file_id, place, stats,
+                                        ledger=ledger)
                 batch, bsize = [], 0
         if hasher.hexdigest() != file_id:
             raise UploadError("resumed stream does not hash to fileId",
@@ -1302,6 +1548,8 @@ class StorageNodeServer:
         stats["uniqueChunks"] = len(seen)
         if stats["minCopies"] is None:
             stats["minCopies"] = self.cfg.cluster.replication_factor
+        if ledger:
+            await self._verify_trusted(file_id, ledger, stats)
         await self._finalize_upload(manifest)
         self.counters.inc("uploads_resumed")
         self.counters.inc("upload_bytes", size)
@@ -1369,7 +1617,8 @@ class StorageNodeServer:
     async def _place_batch(self, file_id: str,
                            batch: list[tuple[str, bytes]],
                            stats: dict, rf: int | None = None,
-                           placement: Mapping[str, tuple[int, ...]] | None = None
+                           placement: Mapping[str, tuple[int, ...]] | None = None,
+                           ledger: _TrustLedger | None = None
                            ) -> None:
         """Place one batch of unique (digest, payload) chunks: local puts
         for canonical ownership, concurrent replication with hash-echo
@@ -1380,7 +1629,15 @@ class StorageNodeServer:
         place single copies — the parity is the redundancy) and
         ``placement`` pins digests to explicit holders (EC stripe
         placement) instead of the digest-derived replica set; the
-        handoff ring then continues cyclically from the pinned holder."""
+        handoff ring then continues cyclically from the pinned holder.
+
+        With the index plane on, each peer's replication pass consults
+        that peer's existence filter first (docs/index.md): digests the
+        filter RULES OUT skip the probe and transfer directly; filter
+        POSITIVES are — when ``ledger`` is given — credited as trusted
+        copies (probe and transfer both skipped; the caller MUST run
+        :meth:`_verify_trusted` on the ledger before acking) or, with
+        no ledger, probed as before minus the ruled-out payload."""
         if self.chaos is not None:
             self.chaos.maybe_crash("place.before_local_put")
         # placement snapshot: ONE ring map for the whole batch — a
@@ -1445,34 +1702,86 @@ class StorageNodeServer:
         async def replicate(node_id: int,
                             wanted: list[tuple[str, bytes]]) -> None:
             peer = self.cfg.cluster.peer(node_id)
-            digests = [d for d, _ in wanted]
             # Known-dead peers get one fast probe instead of the full retry
             # envelope (health registry, SURVEY.md §5.3).
             retries = None if self.health.is_alive(node_id) else 1
+            # peer-filter consultation (docs/index.md): split this
+            # peer's list into ruled-out (definitely absent — transfer
+            # without probing), trusted (filter-positive under a
+            # ledger — probe AND transfer skipped, verified pre-ack),
+            # and to-probe. A dead peer's filter is never trusted (a
+            # stale summary crediting copies on a corpse is exactly
+            # the phantom the health registry exists to prevent); no
+            # replica of the peer's filter = the pre-index path.
+            plane = self.index
+            trusted: set[str] = set()
+            to_probe = wanted
+            if plane is not None and plane.local_filter is not None \
+                    and retries is None \
+                    and plane.peer_filters.state(node_id) is not None:
+                ruled_out = 0
+                to_probe = []
+                for d, b in wanted:
+                    verdict = plane.peer_filters.contains(node_id, d)
+                    if verdict is False:
+                        ruled_out += 1       # straight to transfer
+                    elif ledger is not None:
+                        trusted.add(d)
+                        copies[d] += 1
+                        ledger.credit(node_id, d, len(b))
+                        if (node_id, d) not in counted:
+                            counted.add((node_id, d))
+                            stats["dedupSkippedBytes"] += len(b)
+                    else:
+                        to_probe.append((d, b))
+                plane.probes_skipped += ruled_out + len(trusted)
+                plane.trusted += len(trusted)
+                if not to_probe and wanted:
+                    plane.probe_rpcs_skipped += 1
+            digests = [d for d, _ in to_probe]
             try:
-                # the has_chunks probe flies while the payload list is
-                # staged into bounded slices — fresh data rarely dedups,
-                # so the optimistic staging is usually final; a dedup
-                # hit restages only the missing remainder
-                probe = asyncio.create_task(self.client.call(
-                    peer, {"op": "has_chunks", "digests": digests},
-                    retries=retries))
-                try:
-                    # staging runs on a worker thread so it is GENUINELY
-                    # concurrent with the probe's RTT: the to_thread
-                    # await yields the loop, which runs the probe task's
-                    # send before (and while) the slicing executes —
-                    # inline staging after create_task would still
-                    # serialize ahead of the wire write
-                    staged = await asyncio.to_thread(
-                        self._slice_payloads, wanted,
-                        self._REPLICA_SLICE_BYTES)
-                    resp, _ = await probe
-                except BaseException:
-                    probe.cancel()   # replicate cancelled/failed first:
-                    raise            # don't orphan the probe task
-                have = set(resp.get("have", []))
-                missing = [(d, b) for d, b in wanted if d not in have]
+                staged = None
+                have: set[str] = set()
+                if to_probe is wanted:
+                    # the has_chunks probe flies while the payload list
+                    # is staged into bounded slices — fresh data rarely
+                    # dedups, so the optimistic staging is usually
+                    # final; a dedup hit restages only the missing
+                    # remainder
+                    probe = asyncio.create_task(self.client.call(
+                        peer, {"op": "has_chunks", "digests": digests},
+                        retries=retries))
+                    try:
+                        # staging runs on a worker thread so it is
+                        # GENUINELY concurrent with the probe's RTT:
+                        # the to_thread await yields the loop, which
+                        # runs the probe task's send before (and while)
+                        # the slicing executes — inline staging after
+                        # create_task would still serialize ahead of
+                        # the wire write
+                        staged = await asyncio.to_thread(
+                            self._slice_payloads, wanted,
+                            self._REPLICA_SLICE_BYTES)
+                        resp, _ = await probe
+                    except BaseException:
+                        probe.cancel()   # replicate cancelled/failed
+                        raise            # first: don't orphan the probe
+                    have = set(resp.get("have", []))
+                elif to_probe:
+                    # filter-trimmed probe: only what the filter could
+                    # not rule out goes over the wire
+                    resp, _ = await self.client.call(
+                        peer, {"op": "has_chunks", "digests": digests},
+                        retries=retries)
+                    have = set(resp.get("have", []))
+                    for d in digests:
+                        if d not in have:
+                            # the filter said maybe, the peer says no:
+                            # an OBSERVED false positive — counted, and
+                            # overridden so a retry stops re-trusting
+                            plane.peer_filters.note_fp(node_id, d)
+                missing = [(d, b) for d, b in wanted
+                           if d not in have and d not in trusted]
                 for d, b in wanted:
                     if d in have:
                         # durable on the peer no matter what later
@@ -1490,9 +1799,9 @@ class StorageNodeServer:
                     # the request timeout and failed a whole 2 GiB-corpus
                     # upload below quorum; bounded slices keep each
                     # call's work (and any retry's re-send) small
-                    slices = staged if not have else \
-                        self._slice_payloads(missing,
-                                             self._REPLICA_SLICE_BYTES)
+                    slices = staged if staged is not None and not have \
+                        else self._slice_payloads(
+                            missing, self._REPLICA_SLICE_BYTES)
 
                     def on_slice(part: list[tuple[str, bytes]],
                                  echoed: list[str]) -> None:
@@ -1614,6 +1923,84 @@ class StorageNodeServer:
         stats["handoffChunks"] += len(handoff)
         stats["degraded"] = stats["degraded"] or bool(
             handoff or any(n < rf for n in copies.values()))
+
+    def _new_trust_ledger(self) -> _TrustLedger | None:
+        """A trust ledger when the filter plane is on, else None (the
+        pre-index placement path, probe per batch per peer)."""
+        if self.index is not None and self.index.local_filter is not None:
+            return _TrustLedger()
+        return None
+
+    async def _verify_trusted(self, file_id: str, ledger: _TrustLedger,
+                              stats: dict, rf: int | None = None,
+                              placement: Mapping[str, tuple[int, ...]]
+                              | None = None) -> None:
+        """Confirm every filter-credited copy with ONE real has_chunks
+        round per peer — the pre-ack half of the probe-skipping
+        placement (docs/index.md). Runs after the last batch placed and
+        BEFORE the manifest write that acks the upload, so a bloom
+        false positive (or a peer that died between trust and verify)
+        costs a heal — re-fetching the bytes and re-placing them
+        through the normal batch path — never an ack backed by a
+        phantom copy. Observed FPs are counted (``index.filterFp``)
+        and overridden per peer, so a deterministic bloom collision
+        cannot wedge a retry loop into trusting the same phantom
+        forever."""
+        plane = self.index
+        assert plane is not None
+        unconfirmed: dict[str, int] = {}
+        with self.obs.span("upload.verify_trusted", latency=True):
+            for node_id, entries in sorted(ledger.by_peer.items()):
+                peer = self.cfg.cluster.peer(node_id)
+                digests = sorted(entries)
+                try:
+                    resp, _ = await self.client.call(
+                        peer, {"op": "has_chunks", "digests": digests})
+                    self.health.mark_alive(node_id)
+                except RpcError as e:
+                    # the peer answered the filter sync but not the
+                    # verify: every credit it granted is unconfirmed —
+                    # NOT a false positive (the filter made no mistake;
+                    # the peer is sick), so no FP count/override
+                    if isinstance(e, RpcUnreachable):
+                        self.health.mark_dead(node_id)
+                    self.counters.inc("index_verify_failures")
+                    for d in digests:
+                        stats["dedupSkippedBytes"] -= entries[d]
+                        unconfirmed.setdefault(d, entries[d])
+                    continue
+                have = set(resp.get("have", []))
+                for d in digests:
+                    if d not in have:
+                        plane.peer_filters.note_fp(node_id, d)
+                        stats["dedupSkippedBytes"] -= entries[d]
+                        unconfirmed.setdefault(d, entries[d])
+        if not unconfirmed:
+            return
+        # heal pre-ack: re-fetch the bytes (local CAS first — this node
+        # is usually a holder — then any replica) and re-place through
+        # the normal batch path with NO ledger: real holders dedup, the
+        # phantom target receives an actual transfer (its FP override
+        # stops the filter from re-trusting), dead targets fall to
+        # handoff, and the quorum check re-runs for exactly these
+        # digests. Bytes that survive nowhere reachable fail the upload
+        # loudly — the ack was never given.
+        self.obs.event("filter_fp_replace", chunks=len(unconfirmed))
+        items: list[tuple[str, bytes]] = []
+        local = dict(await self.cas.get_many(sorted(unconfirmed)))
+        for d, ln in sorted(unconfirmed.items()):
+            b = local.get(d)
+            if b is None:
+                try:
+                    b = await self._fetch_chunk(d, ln)
+                except DownloadError:
+                    raise UploadError(
+                        f"filter-credited chunk {d[:12]}… held nowhere "
+                        "reachable — retry the upload (the filter "
+                        "override now forces a real transfer)")
+            items.append((d, b))
+        await self._place_batch(file_id, items, stats, rf=rf,
+                                placement=placement)
 
     async def _finalize_upload(self, manifest: Manifest) -> None:
         # Manifest-last ordering (SURVEY.md §5.4), then best-effort announce
@@ -2521,6 +2908,15 @@ class StorageNodeServer:
             # this node coordinated — feeds the underreplication rule
             "capacity": self._capacity_summary(),
             "census": self._last_census,
+            # dedup/index plane view: peer-filter replica ages — the
+            # doctor's index_stale evidence (a node skipping probes on
+            # weeks-old summaries is mis-placing trust, not saving RPCs)
+            "index": {"enabled": False} if self.index is None else {
+                "enabled": True,
+                "syncS": self.cfg.index.filter_sync_s,
+                "peerAgeS": {str(p): round(a, 3) for p, a in
+                             sorted(self.index.peer_filters.ages()
+                                    .items())}},
             # membership view: epoch + migration progress — the
             # doctor's epoch_mismatch and rebalance_stuck evidence
             "ring": {"epoch": self.ring.epoch,
@@ -3206,13 +3602,45 @@ class StorageNodeServer:
         # below deletes a local stray copy only when every canonical
         # holder is in this set, so a copy is never deleted on faith
         confirmed: dict[str, set[int]] = {}
+        plane = self.index
         for node_id, wanted in need.items():
             peer = self.cfg.cluster.peer(node_id)
             digests = sorted({d for d, _ in wanted})
+            # peer-filter trim (docs/index.md): digests the peer's
+            # filter RULES OUT skip the probe payload — they fall to
+            # to_push below, and the push's hash echo is the real
+            # confirmation. POSITIVES are always probed: the relocation
+            # pass deletes local strays on confirmations, and a bloom
+            # maybe must never stand in for one. (A stale filter can
+            # only cause a redundant push the receiving put dedups.)
+            probe_digests = digests
+            filter_known = (plane is not None
+                            and plane.local_filter is not None
+                            and plane.peer_filters.state(node_id)
+                            is not None)
+            if filter_known:
+                probe_digests = [
+                    d for d in digests
+                    if plane.peer_filters.contains(node_id, d)
+                    is not False]
+                plane.probes_skipped += len(digests) \
+                    - len(probe_digests)
             try:
-                resp, _ = await self.client.call(
-                    peer, {"op": "has_chunks", "digests": digests})
-                have = set(resp.get("have", []))
+                have: set[str] = set()
+                if probe_digests:
+                    resp, _ = await self.client.call(
+                        peer, {"op": "has_chunks",
+                               "digests": probe_digests})
+                    have = set(resp.get("have", []))
+                    if filter_known:
+                        for d in probe_digests:
+                            if d not in have:
+                                # filter said maybe, the peer says no:
+                                # the observed-FP stream the /metrics
+                                # index.filterFp gauge reports
+                                plane.peer_filters.note_fp(node_id, d)
+                elif digests:
+                    plane.probe_rpcs_skipped += 1
                 verified |= have
                 for d in have:
                     confirmed.setdefault(d, set()).add(node_id)

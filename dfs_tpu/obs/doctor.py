@@ -72,6 +72,13 @@ Rules (each produces ``{"rule", "severity", "peers", "evidence"}``):
                        gauge): a dead new owner, exhausted credits, or
                        a wedged repair loop — see its /events journal
                        for the last ``rebalance_start``.
+- ``index_stale``    — a node with the dedup/index plane on holds
+                       peer-filter replicas far older than its
+                       configured sync cadence (r16): placement is
+                       skipping ``has_chunks`` probes against a
+                       membership summary that stopped refreshing —
+                       the gossip loop is failing (see its
+                       ``filter_sync_failures`` counter / journal).
 
 Thresholds live here as module constants, documented in
 docs/observability.md; the bench's injected-slow-peer scenario
@@ -92,6 +99,9 @@ REBALANCE_STUCK_S = 120.0  # migrating with no progress this long =
                         # rebalance_stuck (a healthy rebalance makes
                         # progress every repair cycle; credits stretch
                         # a cycle, they do not zero its progress)
+INDEX_STALE_FACTOR = 10.0  # x the node's configured filter_sync_s
+INDEX_STALE_MIN_S = 60.0   # absolute floor, so a sub-second sync
+                        # cadence does not page on one missed round
 CENSUS_STALE_S = 900.0  # census findings older than this stop firing
                         # the underreplication rule: the census is
                         # pull-only, so a days-old snapshot must not
@@ -404,10 +414,36 @@ def diagnose(snapshots: dict[int, dict | None],
                                 "moved so far — see its /events "
                                 "journal)"})
 
+    def index_stale() -> None:
+        # probe-skipping placement is only as honest as its filter
+        # replicas are fresh: a replica that stopped refreshing means
+        # every "definitely absent" verdict is aging toward wrong
+        for nid, snap in sorted(live.items()):
+            ix = snap.get("index") or {}
+            if not ix.get("enabled"):
+                continue
+            sync_s = ix.get("syncS")
+            if not isinstance(sync_s, (int, float)) or sync_s <= 0:
+                continue   # exchange off: nothing to be stale
+            thresh = max(INDEX_STALE_MIN_S, INDEX_STALE_FACTOR * sync_s)
+            stale = {p: age for p, age in (ix.get("peerAgeS")
+                                           or {}).items()
+                     if isinstance(age, (int, float)) and age >= thresh}
+            if stale:
+                worst = max(stale.values())
+                findings.append({
+                    "rule": "index_stale", "severity": "warning",
+                    "peers": [nid],
+                    "evidence": f"peer-filter replica(s) of node(s) "
+                                f"{sorted(stale)} up to {worst:.0f}s "
+                                f"old (sync cadence {sync_s:g}s) — "
+                                "probe-skipping placement is trusting "
+                                "a summary that stopped refreshing"})
+
     for rule in (dead_peer, slow_peer, shed_storm, credit_starvation,
                  cache_thrash, clock_skew, config_drift, loop_lag,
                  capacity_trend, underreplication, epoch_mismatch,
-                 rebalance_stuck):
+                 rebalance_stuck, index_stale):
         try:
             rule()
         except Exception as e:   # noqa: BLE001 — see docstring

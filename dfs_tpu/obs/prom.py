@@ -193,5 +193,37 @@ def render_node_metrics(node) -> str:
             fam("dfs_census_orphaned", "gauge")
             lines.append(f"dfs_census_orphaned "
                          f"{last.get('orphaned', 0)}")
+    # dedup/index plane (r16): LSI + filter gauges and the probe-skip
+    # counters — present only when the plane is on (additive, like the
+    # census block above). getattr-guarded for standalone/test fakes.
+    index_stats = getattr(node, "index_stats", None)
+    if index_stats is not None:
+        ix = index_stats()
+        lsi = ix.get("lsi")
+        if lsi:
+            for key, fam_name in (
+                    ("memtableBytes", "dfs_index_memtable_bytes"),
+                    ("runCount", "dfs_index_runs"),
+                    ("runEntries", "dfs_index_run_entries")):
+                fam(fam_name, "gauge")
+                lines.append(f"{fam_name} {lsi.get(key, 0)}")
+            fam("dfs_index_compactions_total", "counter")
+            lines.append(f"dfs_index_compactions_total "
+                         f"{lsi.get('compactions', 0)}")
+            fam("dfs_index_rebuilds_total", "counter")
+            lines.append(f"dfs_index_rebuilds_total "
+                         f"{lsi.get('rebuilds', 0)}")
+        if "probesSkipped" in ix:
+            fam("dfs_index_filter_bytes", "gauge")
+            lines.append(f"dfs_index_filter_bytes "
+                         f"{(ix.get('filter') or {}).get('bytes', 0)}")
+            for key, fam_name in (
+                    ("probesSkipped", "dfs_index_probes_skipped"),
+                    ("probeRpcsSkipped",
+                     "dfs_index_probe_rpcs_skipped"),
+                    ("filterTrusted", "dfs_index_filter_trusted"),
+                    ("filterFp", "dfs_index_filter_fp")):
+                fam(f"{fam_name}_total", "counter")
+                lines.append(f"{fam_name}_total {ix.get(key, 0)}")
     lines.append("# EOF")   # OpenMetrics required terminator
     return "\n".join(lines) + "\n"

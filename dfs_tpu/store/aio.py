@@ -122,6 +122,23 @@ class AsyncChunkStore:
             self._wpool,
             lambda: self.store.put(digest, data, verify=verify), "cas.put")
 
+    async def has_many(self, digests: Sequence[str]) -> list[bool]:
+        """Batched local existence — ONE worker job for the whole
+        probe list. The ``has_chunks`` server path and the resume
+        probe used to pay a per-digest job (or, worse, inline loop
+        stats); a hot probe service must cost one worker dispatch per
+        LIST. Each ``has`` rides the index fast path when the dedup
+        plane is on (store/cas.py) and a stat otherwise. On the
+        LATENCY lane (``cas-g``), not the batch-read lane: a probe is
+        stats/index hits — microseconds — and peers time budget it
+        like a metadata op, so it must never queue behind a
+        multi-second ``get_many`` gather."""
+        if not digests:
+            return []
+        ds = list(digests)
+        return await self._run(
+            self._gpool, lambda: self.store.has_many(ds), "cas.has_many")
+
     async def get_many(self, digests: Sequence[str]
                        ) -> list[tuple[str, bytes]]:
         """(digest, bytes) for every digest present locally — one worker

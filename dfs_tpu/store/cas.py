@@ -116,10 +116,21 @@ class ChunkStore:
         self._root_str = os.fspath(self.root)
         self._fsync = bool(fsync)
         self.fault = None                  # chaos hook: fault(op, digest)
+        # dedup/index seam (dfs_tpu.index.IndexPlane): when set, every
+        # put/delete feeds the log-structured digest index FROM THE
+        # CALLING THREAD (the bounded CAS workers — DFS001-clean) and
+        # has() answers positive hits from it without a stat. None
+        # (the default) keeps the pre-index paths byte-identical.
+        self.index = None
         self._count: int | None = None     # lazy; maintained by put/delete
         self._bytes: int | None = None     # lazy; maintained by put/delete
         self._fsyncs = 0                   # barriers issued (durability_stats)
         self._count_lock = threading.Lock()   # puts run in to_thread pools
+        # orders the visible link/unlink against its index record: a
+        # put racing a delete of the SAME digest could otherwise
+        # interleave (link, note_delete, unlink, note_put) and leave a
+        # stale "present" — the one divergence the index design forbids
+        self._index_mu = threading.Lock()
         self._dirs: set[str] = set()       # subdirs known to exist
         self._tmp_seq = itertools.count()  # cheap unique tmp names
 
@@ -137,7 +148,39 @@ class ChunkStore:
         return f"{self._root_str}/{digest[:2]}/{digest}"
 
     def has(self, digest: str) -> bool:
-        return os.path.isfile(self._path_str(digest))
+        """Local existence. With the index plane attached, a positive
+        index answer is final — puts are recorded only AFTER the link
+        is visible and deletes BEFORE the unlink (see ``put`` /
+        ``delete``), so "present" in the index implies the file was
+        durably linked and no delete has begun; the residual caveat is
+        external directory mutation, the same class count() documents.
+        A NEGATIVE index answer falls through to the stat — the
+        negative-confirmation backstop: the index may lag a put (its
+        WAL buffers put records; a kill -9 loses the buffer — the safe
+        direction), and claiming absence for a present chunk would
+        cost a redundant transfer per probe. The backstop is
+        SELF-HEALING: a stat that contradicts the index re-records the
+        digest (under the same ordering mutex a racing delete takes),
+        so a crash-lost record costs one stat, not one per probe
+        forever — and the first post-restart repair probe sweep
+        re-indexes everything it touches."""
+        if self.index is None:
+            return os.path.isfile(self._path_str(digest))
+        if self.index.lookup(digest):
+            return True
+        with self._index_mu:
+            present = os.path.isfile(self._path_str(digest))
+            if present:
+                self.index.note_put(digest, defer_flush=True)
+        if present:
+            self.index.maybe_flush()       # outside the ordering mutex
+        return present
+
+    def has_many(self, digests) -> list[bool]:
+        """Batched :meth:`has` — one call for a whole probe list, so
+        async callers pay one thread-pool job instead of one per
+        digest (:meth:`AsyncChunkStore.has_many`)."""
+        return [self.has(d) for d in digests]
 
     def put(self, digest: str, data: bytes, verify: bool = True) -> bool:
         """Store a chunk. Returns False if it already existed (dedup hit).
@@ -155,6 +198,16 @@ class ChunkStore:
             self.fault("put", digest)
         p = self._path_str(digest)
         if os.path.isfile(p):
+            if self.index is not None and not self.index.lookup(digest):
+                # dedup hit on a chunk the index forgot (crash-lost WAL
+                # buffer): heal here too — a repair push re-sending a
+                # restarted node its own chunks is exactly how that
+                # node's catalog re-enters the index (same ordering
+                # mutex discipline as has()'s backstop)
+                with self._index_mu:
+                    if os.path.isfile(p):
+                        self.index.note_put(digest, defer_flush=True)
+                self.index.maybe_flush()
             return False
         if verify and sha256_hex(data) != digest:
             raise ValueError(f"data does not match digest {digest[:12]}…")
@@ -183,26 +236,36 @@ class ChunkStore:
                 if self._fsync:
                     f.flush()
                     os.fsync(f.fileno())
-            try:
-                os.link(tmp, p)
-            except FileExistsError:
-                return False
-            except OSError as e:
-                # filesystem without hard links: fall back to atomic
-                # rename. Loses the exactly-one-True race guarantee
-                # (both racers see True, count drifts by one until
-                # restart) but never loses data — rename is still atomic
-                # and content-addressed names make the overwrite
-                # idempotent. Only the no-hardlink errnos take the
-                # fallback; anything else (vanished tmp, EIO, and EXDEV
-                # — tmp is created in the target's OWN directory, so a
-                # cross-device link error means something anomalous that
-                # os.replace would also fail on, just with a less
-                # accurate traceback) stays loud with its real cause.
-                if e.errno not in (errno.EPERM, errno.EOPNOTSUPP,
-                                   errno.ENOTSUP, errno.EMLINK):
-                    raise
-                os.replace(tmp, p)
+            with self._index_mu:
+                try:
+                    os.link(tmp, p)
+                except FileExistsError:
+                    return False
+                except OSError as e:
+                    # filesystem without hard links: fall back to atomic
+                    # rename. Loses the exactly-one-True race guarantee
+                    # (both racers see True, count drifts by one until
+                    # restart) but never loses data — rename is still
+                    # atomic and content-addressed names make the
+                    # overwrite idempotent. Only the no-hardlink errnos
+                    # take the fallback; anything else (vanished tmp,
+                    # EIO, and EXDEV — tmp is created in the target's
+                    # OWN directory, so a cross-device link error means
+                    # something anomalous that os.replace would also
+                    # fail on, just with a less accurate traceback)
+                    # stays loud with its real cause.
+                    if e.errno not in (errno.EPERM, errno.EOPNOTSUPP,
+                                       errno.ENOTSUP, errno.EMLINK):
+                        raise
+                    os.replace(tmp, p)
+                if self.index is not None:
+                    # recorded AFTER the link is visible (inside the
+                    # ordering lock): a crash between the two leaves a
+                    # false NEGATIVE — has()'s stat backstop covers
+                    # it. The flush/compaction threshold runs AFTER
+                    # the mutex drops (below) — a multi-second merge
+                    # inside it would freeze every CAS worker.
+                    self.index.note_put(digest, defer_flush=True)
             if self._fsync:
                 # the NAME is durable only once the directory block is:
                 # link/rename ordered the visible state, the dirfd fsync
@@ -222,6 +285,8 @@ class ChunkStore:
                 self._count += 1
             if self._bytes is not None:
                 self._bytes += len(data)
+        if self.index is not None:
+            self.index.maybe_flush()   # outside the ordering mutex
         return True
 
     def fsync_count(self) -> int:
@@ -245,13 +310,23 @@ class ChunkStore:
             # stat→unlink race to a concurrent delete means the unlink
             # raises and neither gauge moves — same story as put's
             # exactly-one-True link race
-            size = os.path.getsize(p)
-            os.unlink(p)
+            with self._index_mu:
+                size = os.path.getsize(p)
+                if self.index is not None:
+                    # recorded BEFORE the unlink (written through, not
+                    # buffered): a crash between the two leaves a false
+                    # negative for a present chunk — the safe
+                    # direction; the reverse order could persist a
+                    # stale "present" for vanished bytes
+                    self.index.note_delete(digest, defer_flush=True)
+                os.unlink(p)
             with self._count_lock:
                 if self._count is not None:
                     self._count -= 1
                 if self._bytes is not None:
                     self._bytes -= size
+            if self.index is not None:
+                self.index.maybe_flush()   # outside the ordering mutex
             return True
         except FileNotFoundError:
             return False

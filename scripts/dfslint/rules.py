@@ -277,7 +277,7 @@ def check_digest_boundary(project: Project) -> Iterator[Finding]:
 # deployment to the default — the drift this rule exists to catch)
 _CLI_CLASSES = ("NodeConfig", "ServeConfig", "IngestConfig", "ObsConfig",
                 "FragmenterConfig", "CensusConfig", "DurabilityConfig",
-                "ChaosConfig", "RingConfig")
+                "ChaosConfig", "RingConfig", "IndexConfig")
 # config field -> /metrics key that surfaces it, per stats function.
 # "cas" carries cas_io_threads as its nested workers count
 # (store/aio.py stats()).
@@ -341,6 +341,14 @@ _CHAOS_METRIC_KEYS = {"enabled": "enabled", "seed": "seed",
 # (node/runtime.py ring_stats())
 _RING_METRIC_KEYS = {"vnodes": "vnodes", "members": "members",
                      "rebalance_credit_bytes": "rebalanceCreditBytes"}
+
+# dedup/index-plane knobs surface under /metrics "index"
+# (node/runtime.py index_stats())
+_INDEX_METRIC_KEYS = {"enabled": "enabled",
+                      "memtable_entries": "memtableEntries",
+                      "compact_runs": "compactRuns",
+                      "filter_bits_per_key": "filterBitsPerKey",
+                      "filter_sync_s": "filterSyncS"}
 
 
 def _dataclass_fields(src: SourceFile) -> dict[str, dict[str, int]]:
@@ -503,7 +511,9 @@ def check_config_drift(project: Project) -> Iterator[Finding]:
             (runtime, "durability_stats", "DurabilityConfig",
              _DURABILITY_METRIC_KEYS),
             (chaos_pkg, "stats", "ChaosConfig", _CHAOS_METRIC_KEYS),
-            (runtime, "ring_stats", "RingConfig", _RING_METRIC_KEYS)):
+            (runtime, "ring_stats", "RingConfig", _RING_METRIC_KEYS),
+            (runtime, "index_stats", "IndexConfig",
+             _INDEX_METRIC_KEYS)):
         if src is None or src.tree is None or cls not in classes:
             continue
         keys = _stats_dict_keys(src, func)

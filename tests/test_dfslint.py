@@ -499,6 +499,58 @@ def test_dfs005_ring_fields_checked(tmp_path):
                            "dfs_tpu/node/runtime.py": runtime_ok}) == []
 
 
+def test_dfs005_index_fields_checked(tmp_path):
+    """r16: IndexConfig rides the same three DFS005 edges — a dedup/
+    index knob dropped from cmd_serve's constructor, and one whose
+    /metrics key vanishes from index_stats(), must both be findings;
+    the wired fixture must be clean."""
+    cfg = (
+        "import dataclasses\n"
+        "@dataclasses.dataclass(frozen=True)\n"
+        "class IndexConfig:\n"
+        "    enabled: bool = False\n"
+        "    filter_sync_s: float = 5.0\n")
+    cli_missing = (
+        "from dfs_tpu.config import IndexConfig\n"
+        "def cmd_serve(args):\n"
+        "    return IndexConfig(enabled=args.index)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--index', action='store_true')\n")
+    runtime_ok = (
+        "class S:\n"
+        "    def index_stats(self):\n"
+        "        return {'enabled': False, 'filterSyncS': 5.0}\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/cli/main.py": cli_missing,
+                            "dfs_tpu/node/runtime.py": runtime_ok})
+    assert rules_of(found) == ["DFS005"]
+    assert "IndexConfig.filter_sync_s" in found[0].message
+
+    cli_ok = (
+        "from dfs_tpu.config import IndexConfig\n"
+        "def cmd_serve(args):\n"
+        "    return IndexConfig(enabled=args.index,\n"
+        "                       filter_sync_s=args.index_filter_sync)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--index', action='store_true')\n"
+        "    sub.add_argument('--index-filter-sync', type=float,\n"
+        "                     default=5.0)\n")
+    runtime_missing_key = (
+        "class S:\n"
+        "    def index_stats(self):\n"
+        "        return {'enabled': False}\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/cli/main.py": cli_ok,
+                            "dfs_tpu/node/runtime.py":
+                            runtime_missing_key})
+    assert rules_of(found) == ["DFS005"]
+    assert "filterSyncS" in found[0].message
+
+    assert lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                           "dfs_tpu/cli/main.py": cli_ok,
+                           "dfs_tpu/node/runtime.py": runtime_ok}) == []
+
+
 def test_dfs005_unmapped_field_needs_table_entry(tmp_path):
     cfg = (
         "import dataclasses\n"
@@ -825,3 +877,12 @@ def test_serve_cli_exposes_every_config_field():
     assert (ns.write_quorum, ns.probe_interval, ns.rpc_retries) == (1, 0, 2)
     assert (ns.connect_timeout, ns.request_timeout) == (0.5, 3.0)
     assert (ns.retry_after, ns.fixed_parts) == (2.5, 7)
+    # r16 dedup/index plane flags land in IndexConfig fields
+    ns = build_parser().parse_args(
+        ["serve", "--node-id", "1", "--index",
+         "--index-memtable-entries", "512", "--index-compact-runs",
+         "3", "--index-filter-bits", "12", "--index-filter-sync",
+         "2.5"])
+    assert ns.index is True
+    assert (ns.index_memtable_entries, ns.index_compact_runs) == (512, 3)
+    assert (ns.index_filter_bits, ns.index_filter_sync) == (12, 2.5)

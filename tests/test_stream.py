@@ -167,11 +167,13 @@ def test_upload_stream_abort_stops_body_and_commits_nothing(tmp_path, rng):
     real_place = node._place_batch
     calls = {"n": 0}
 
-    async def flaky_place(file_id, batch, stats, rf=None, placement=None):
+    async def flaky_place(file_id, batch, stats, rf=None,
+                          placement=None, ledger=None):
         calls["n"] += 1
         if calls["n"] >= 2:
             raise UploadError("Replication failed: injected")
-        await real_place(file_id, batch, stats, rf=rf, placement=placement)
+        await real_place(file_id, batch, stats, rf=rf,
+                         placement=placement, ledger=ledger)
 
     node._place_batch = flaky_place
     consumed = {"blocks": 0}

@@ -67,6 +67,61 @@ PREFIX_LEN = _PREFIX.size
 MAX_HEADER = 64 * 1024 * 1024
 MAX_BODY = 8 * 1024 * 1024 * 1024
 
+# ---------------------------------------------------------------------
+# The internal-op contract, as data. One entry per op the storage plane
+# speaks: the request header fields a client may send and the reply
+# header fields a handler may produce — beyond the envelope the
+# transport owns (`op`, optional `trace`, the ring-epoch pair
+# `repoch`/`rfp` on placement-bearing ops, and `ok`/`error` plus the
+# `ringEpoch`/`ring` refusal pair on every reply). `body` notes the
+# binary payload direction for humans; the checker does not model it.
+#
+# dfslint DFS010 (docs/lint.md) statically extracts the op set from the
+# client call sites (comm/rpc.py + the runtime's raw sends) and the
+# handler table (node/runtime.py `_dispatch`) and fails the gate when
+# the three disagree: an op sent but unhandled, handled but missing
+# here, documented here but unhandled, or a request/reply field read by
+# one side and never produced by the other. Editing ANY side of the
+# wire therefore means editing all three, in one PR — the drift this
+# table exists to make impossible.
+OP_SPECS = {
+    "store_chunks": {"request": ["fileId", "chunks"],
+                     "reply": ["digests"],
+                     "body": "request: chunk payloads (scatter-gather)"},
+    "has_chunks": {"request": ["digests"], "reply": ["have"],
+                   "body": None},
+    "get_chunk": {"request": ["digest"], "reply": [],
+                  "body": "reply: chunk payload"},
+    "get_chunks": {"request": ["digests"], "reply": ["chunks"],
+                   "body": "reply: chunk payloads (table in header)"},
+    "announce": {"request": ["manifest", "fresh"], "reply": [],
+                 "body": None},
+    "get_manifest": {"request": ["fileId"], "reply": ["manifest",
+                                                      "mtime"],
+                     "body": None},
+    "delete": {"request": ["fileId"], "reply": [], "body": None},
+    "tombstones": {"request": [], "reply": ["tombs"], "body": None},
+    "list_manifests": {"request": [], "reply": ["ids"], "body": None},
+    "health": {"request": [], "reply": ["nodeId", "chunks", "files"],
+               "body": None},
+    "get_trace": {"request": ["traceId"], "reply": ["spans"],
+                  "body": None},
+    "get_doctor": {"request": [], "reply": ["doctor"], "body": None},
+    "get_census": {"request": ["prefixes"], "reply": ["census"],
+                   "body": None},
+    "get_ring": {"request": [], "reply": ["ring", "previous",
+                                          "migrating"],
+                 "body": None},
+    "propose_ring": {"request": ["ring"], "reply": ["epoch",
+                                                    "installed"],
+                     "body": None},
+    "get_filter": {"request": [], "reply": ["filter"],
+                   "body": "reply: blocked-bloom filter bytes"},
+    "filter_delta": {"request": ["gen", "since"],
+                     "reply": ["resync", "gen", "version", "adds"],
+                     "body": None},
+}
+
 # one payload buffer; a frame body is one of these or a sequence of them
 Buffer = Union[bytes, bytearray, memoryview]
 

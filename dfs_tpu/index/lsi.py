@@ -223,8 +223,13 @@ class DigestIndex:
                 json.JSONDecodeError) as e:
             reason = f"{type(e).__name__}: {e}"
             entries = self._rebuild(cas_digests())
+        # run-list length read under the lock: boot ordering makes an
+        # unlocked read safe TODAY, but nothing pins open_or_rebuild to
+        # run before the workers start (dfslint DFS008)
+        with self._lock:
+            nruns = len(self._runs)
         info = {"rebuilt": reason is not None, "entries": entries,
-                "runs": len(self._runs), "reason": reason}
+                "runs": nruns, "reason": reason}
         if reason is not None and self.on_event is not None:
             self.on_event("index_rebuild", entries=entries,
                           reason=reason[:160])

@@ -342,7 +342,13 @@ class ChunkStore:
         restart — acceptable for a diagnostics field). The priming scan
         runs OUTSIDE the lock so a big store's first probe cannot stall
         concurrent put/delete workers behind it."""
-        if self._count is None:
+        with self._count_lock:
+            primed = self._count
+        if primed is None:
+            # priming scan stays OUTSIDE the lock (a big store's first
+            # probe must not stall put/delete workers behind it); the
+            # peek above runs under it — an unlocked peek raced the
+            # worker-side writes (dfslint DFS008)
             n = len(self.digests())
             with self._count_lock:
                 if self._count is None:
@@ -372,7 +378,10 @@ class ChunkStore:
         ``inventory()`` pass outside the lock, then maintained by
         put/delete; the same external-writes skew caveat as the count
         applies (re-primed on restart)."""
-        if self._bytes is None:
+        with self._count_lock:
+            primed = self._bytes
+        if primed is None:
+            # same locked-peek/unlocked-scan split as count()
             n = self.inventory()["bytes"]   # primes both gauges
             with self._count_lock:
                 if self._bytes is None:

@@ -25,21 +25,44 @@ Usage::
 
 from __future__ import annotations
 
+import time
+
 from scripts.dfslint.core import (Finding, Project, SourceFile,
                                   collect_sources, load_baseline,
                                   save_baseline)
-from scripts.dfslint.rules import ALL_RULES, run_rules
+from scripts.dfslint.model import ProjectModel, build_model
+from scripts.dfslint.rules import (ALL_RULES, audit_baseline, run_rules)
 
-__all__ = ["ALL_RULES", "Finding", "Project", "SourceFile", "analyze",
-           "collect_sources", "load_baseline", "run_rules",
-           "save_baseline"]
+__all__ = ["ALL_RULES", "Finding", "Project", "ProjectModel",
+           "SourceFile", "analyze", "build_model", "collect_sources",
+           "load_baseline", "run_rules", "save_baseline"]
 
 
-def analyze(roots, repo_root, baseline: set[str] | frozenset[str] = frozenset()
-            ) -> list[Finding]:
-    """Walk ``roots``, run every rule, drop suppressed + baselined
-    findings. The one entry point the CLI and the tier-1 test share."""
+def analyze(roots, repo_root,
+            baseline: set[str] | frozenset[str] = frozenset(),
+            stats: dict | None = None) -> list[Finding]:
+    """Walk ``roots``, run every rule (phase-1 model built once, shared
+    by all of them), drop suppressed + baselined findings, and audit
+    stale baseline entries. The one entry point the CLI and the tier-1
+    test share. ``stats``, when given, is filled in place with the
+    ``--stats`` timing breakdown: ``files``, ``walkS``, ``totalS``,
+    and per-phase ``phases`` (model + each rule + audit)."""
+    t_start = time.perf_counter()
     project = Project(collect_sources(roots, repo_root))
-    out = [f for f in run_rules(project) if f.key not in baseline]
+    t_walk = time.perf_counter() - t_start
+    timings: dict | None = {} if stats is not None else None
+    findings = run_rules(project, timings=timings)
+    live_keys = {f.key for f in findings}
+    out = [f for f in findings if f.key not in baseline]
+    out.extend(audit_baseline(project, set(baseline), live_keys))
     out.sort(key=lambda f: (f.path, f.line, f.rule))
+    if stats is not None:
+        stats.update({
+            "files": len(project.files),
+            "findings": len(out),
+            "walkS": round(t_walk, 6),
+            "phases": {k: round(v, 6)
+                       for k, v in (timings or {}).items()},
+            "totalS": round(time.perf_counter() - t_start, 6),
+        })
     return out

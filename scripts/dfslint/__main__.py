@@ -4,6 +4,12 @@ Exit-code contract (stable for CI):
   0 — clean (no findings beyond the baseline)
   1 — findings
   2 — usage error (unknown flag, nonexistent path, malformed baseline)
+
+Output formats: human text (default), ``--format json`` (alias
+``--json``), ``--format sarif`` (SARIF 2.1.0 — GitHub code scanning
+and every SARIF-aware CI viewer ingest it directly). ``--stats`` adds
+the per-phase timing breakdown (walk/parse, phase-1 model, each rule,
+audit) that the tier-1 wall-clock budget is asserted against.
 """
 
 from __future__ import annotations
@@ -15,38 +21,89 @@ from pathlib import Path
 
 from scripts.dfslint import analyze, load_baseline, save_baseline
 from scripts.dfslint.core import DEFAULT_BASELINE
+from scripts.dfslint.rules import ALL_RULES
 
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 # tier-1 scope: the package, the tooling, and the bench drivers
 DEFAULT_ROOTS = ("dfs_tpu", "scripts", "bench*.py")
 
+_SARIF_LEVEL = {"error": "error", "warning": "warning"}
+
+
+def to_sarif(findings) -> dict:
+    """Minimal valid SARIF 2.1.0 run: one driver, one rule entry per
+    registered rule (plus DFS000), one result per finding."""
+    rules = [{"id": "DFS000",
+              "shortDescription": {"text": "parse error / stale "
+                                           "suppression or baseline"}}]
+    rules += [{"id": rid, "shortDescription": {"text": desc}}
+              for rid, desc, _fn in ALL_RULES]
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": _SARIF_LEVEL.get(f.severity, "error"),
+            "message": {"text": f.message},
+            "partialFingerprints": {"dfslintKey/v1": f.key},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line),
+                               "startColumn": max(1, f.col + 1)},
+                }}],
+        })
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "dfslint",
+                "informationUri": "docs/lint.md",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
+
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m scripts.dfslint",
-        description="AST concurrency & invariant analyzer for the async "
-                    "node runtime (rules DFS001-DFS005, docs/lint.md)")
+        description="two-phase AST concurrency & invariant analyzer "
+                    "for the async node runtime (rules DFS001-DFS010, "
+                    "docs/lint.md)")
     ap.add_argument("paths", nargs="*", default=list(DEFAULT_ROOTS),
                     help="files/dirs/globs relative to the repo root "
                          f"(default: {' '.join(DEFAULT_ROOTS)})")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default=None,
+                    help="output format (default text; sarif = SARIF "
+                         "2.1.0 for CI ingestion)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable findings on stdout")
+                    help="alias for --format json")
+    ap.add_argument("--stats", action="store_true",
+                    help="print the per-phase timing breakdown (text) "
+                         "/ embed it (json)")
     ap.add_argument("--baseline", default=None, metavar="PATH",
                     help=f"baseline file (default {DEFAULT_BASELINE})")
     ap.add_argument("--update-baseline", action="store_true",
-                    help="accept every current finding into the baseline "
-                         "and exit 0")
+                    help="accept every current finding into the "
+                         "baseline (pruning stale entries) and exit 0")
     try:
         args = ap.parse_args(argv)
     except SystemExit as e:
         # argparse exits 2 on usage error, 0 on --help: preserve both
         return int(e.code or 0)
+    fmt = args.format or ("json" if args.as_json else "text")
 
+    stats: dict = {}
     try:
         baseline = set() if args.update_baseline \
             else load_baseline(args.baseline)
         findings = analyze(args.paths or list(DEFAULT_ROOTS), REPO_ROOT,
-                           baseline=baseline)
+                           baseline=baseline,
+                           stats=stats if args.stats else None)
     except FileNotFoundError as e:
         print(f"dfslint: no such path: {e}", file=sys.stderr)
         return 2
@@ -55,7 +112,10 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     if args.update_baseline:
-        keys = {f.key for f in findings}
+        # DFS000 never enters the baseline: parse errors must be FIXED,
+        # and accepting a stale-suppression/-baseline warning would
+        # re-create exactly the rot the audit exists to surface
+        keys = {f.key for f in findings if f.rule != "DFS000"}
         if args.paths and args.paths != list(DEFAULT_ROOTS):
             # narrowed scope: keep accepted keys the scan did not cover
             # — rewriting from a partial run would silently un-accept
@@ -72,14 +132,24 @@ def main(argv: list[str] | None = None) -> int:
               f"key(s)) -> {path}")
         return 0
 
-    if args.as_json:
-        print(json.dumps({
-            "findings": [f.to_json() for f in findings],
-            "count": len(findings),
-        }, indent=2, sort_keys=True))
+    if fmt == "json":
+        doc = {"findings": [f.to_json() for f in findings],
+               "count": len(findings)}
+        if args.stats:
+            doc["stats"] = stats
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    elif fmt == "sarif":
+        print(json.dumps(to_sarif(findings), indent=2, sort_keys=True))
     else:
         for f in findings:
             print(f.render())
+        if args.stats:
+            phases = " ".join(f"{k}={v:.3f}s" for k, v in
+                              stats.get("phases", {}).items())
+            print(f"dfslint: {stats.get('files', 0)} files "
+                  f"walk={stats.get('walkS', 0.0):.3f}s {phases} "
+                  f"total={stats.get('totalS', 0.0):.3f}s",
+                  file=sys.stderr)
         if findings:
             print(f"dfslint: {len(findings)} finding(s) — see "
                   "docs/lint.md for the rule catalogue and suppression "

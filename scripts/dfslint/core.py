@@ -33,8 +33,14 @@ SKIP_DIRS = frozenset({"__pycache__", ".git", ".pytest_cache",
 
 SEVERITIES = ("error", "warning")
 
+# a suppression is the marker inside a real COMMENT token, introduced
+# at the comment start or after whitespace (`# noqa  # dfslint: …`
+# combines; a docstring or a backtick-quoted mention in prose — docs,
+# the linter's own sources — is NOT a suppression; the r17
+# stale-suppression audit made that distinction load-bearing)
 _SUPPRESS = re.compile(
-    r"#\s*dfslint:\s*ignore(?:\[\s*([A-Za-z0-9_,\s]+?)\s*\])?")
+    r"(?:^|(?<=\s))#\s*dfslint:\s*ignore"
+    r"(?:\[\s*([A-Za-z0-9_,\s]+?)\s*\])?")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,23 +83,36 @@ class SourceFile:
         self.tree: ast.Module | None = None
         self.parse_error: SyntaxError | None = None
         self.parents: dict[ast.AST, ast.AST] = {}
+        # parse-once node index: every node bucketed by type in ONE walk
+        # (each of the r08 rules re-ran ast.walk per file; with the
+        # interprocedural phase the shared index keeps the whole run
+        # inside the tier-1 wall-clock budget — see --stats)
+        self._by_type: dict[type, list[ast.AST]] = {}
         try:
             self.tree = ast.parse(self.text)
         except SyntaxError as e:
             self.parse_error = e
         if self.tree is not None:
             for parent in ast.walk(self.tree):
+                self._by_type.setdefault(type(parent), []).append(parent)
                 for child in ast.iter_child_nodes(parent):
                     self.parents[child] = parent
         # line -> set of suppressed rule ids; "*" = all rules. A bare
         # standalone `# dfslint: ignore[...]` comment line covers the
         # next non-comment, non-blank line (so a suppression can carry
-        # its justification without fighting line length).
+        # its justification without fighting line length). Comments are
+        # found by TOKENIZING (not a per-line regex): a string literal
+        # containing the marker — docs quoting the syntax — must not
+        # count, or the stale-suppression audit flags the quote.
         self.suppressed: dict[int, set[str]] = {}
+        # (line, rule) pairs that actually suppressed a finding this
+        # run — the DFS000 stale-suppression audit's evidence
+        self.suppressions_used: set[tuple[int, str]] = set()
+        comments = self._comment_lines()
         carry: set[str] | None = None
         for lineno, raw in enumerate(self.lines, 1):
             stripped = raw.strip()
-            m = _SUPPRESS.search(raw)
+            m = _SUPPRESS.search(comments.get(lineno, ""))
             rules: set[str] | None = None
             if m:
                 rules = ({r.strip().upper() for r in m.group(1).split(",")}
@@ -111,9 +130,44 @@ class SourceFile:
             if eff:
                 self.suppressed[lineno] = eff
 
+    def _comment_lines(self) -> dict[int, str]:
+        """line -> comment token text, via tokenize. Unparseable files
+        yield nothing (the DFS000 parse-error finding covers them)."""
+        import io
+        import tokenize
+
+        out: dict[int, str] = {}
+        if "dfslint:" not in self.text:
+            return out   # no marker anywhere: skip the tokenize pass
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    out[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError, SyntaxError,
+                ValueError):
+            pass
+        return out
+
     def is_suppressed(self, rule: str, line: int) -> bool:
         got = self.suppressed.get(line)
-        return bool(got) and ("*" in got or rule in got)
+        hit = bool(got) and ("*" in got or rule in got)
+        if hit:
+            # audit bookkeeping (DFS000 stale-suppression): this
+            # comment suppressed a live finding this run
+            self.suppressions_used.add(
+                (line, rule if rule in (got or ()) else "*"))
+        return hit
+
+    def nodes(self, *types: type) -> list[ast.AST]:
+        """All AST nodes of the given types, from the shared parse-once
+        index (lexical order within a type)."""
+        if len(types) == 1:
+            return self._by_type.get(types[0], [])
+        out: list[ast.AST] = []
+        for t in types:
+            out.extend(self._by_type.get(t, []))
+        return out
 
     # ---- AST helpers shared by the rules ----
 
@@ -163,6 +217,7 @@ class Project:
 
     def __init__(self, files: list[SourceFile]) -> None:
         self.files = files
+        self._model = None   # phase-1 facts, built once (model.build_model)
 
     def find(self, rel_suffix: str) -> SourceFile | None:
         """The unique source whose repo-relative path ends with

@@ -1,0 +1,82 @@
+"""CI annotation hook: run dfslint and emit findings as file:line
+annotations a CI runner renders inline on the diff.
+
+Two formats, selected by ``--style``:
+
+- ``gh`` (default): GitHub Actions workflow commands —
+  ``::error file=<path>,line=<n>,col=<n>,title=<RULE>::<message>`` —
+  which the Actions runner turns into inline PR annotations with zero
+  extra tooling (warnings map to ``::warning``).
+- ``plain``: ``<path>:<line>:<col>: <RULE> <severity>: <message>`` —
+  the gcc-style line every editor/CI log-matcher parses.
+
+Exit code mirrors ``python -m scripts.dfslint``: 0 clean, 1 findings,
+2 usage error — so the same invocation both annotates and gates.
+SARIF-consuming CI uses ``python -m scripts.dfslint --format sarif``
+instead; this hook is for runners that want plain-text annotations.
+
+Usage::
+
+    python scripts/dfslint_annotate.py [--style gh|plain] [paths...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from scripts.dfslint import analyze, load_baseline  # noqa: E402
+from scripts.dfslint.__main__ import DEFAULT_ROOTS  # noqa: E402
+
+
+def _gh_escape(s: str) -> str:
+    """Workflow-command data escaping (the Actions runner's rules:
+    % first, then newlines; properties additionally escape , and :)."""
+    return s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+
+
+def _gh_prop(s: str) -> str:
+    return _gh_escape(s).replace(":", "%3A").replace(",", "%2C")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python scripts/dfslint_annotate.py",
+        description="emit dfslint findings as CI file:line annotations")
+    ap.add_argument("paths", nargs="*", default=list(DEFAULT_ROOTS))
+    ap.add_argument("--style", choices=("gh", "plain"), default="gh")
+    ap.add_argument("--baseline", default=None, metavar="PATH")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+
+    try:
+        findings = analyze(args.paths or list(DEFAULT_ROOTS), REPO_ROOT,
+                           baseline=load_baseline(args.baseline))
+    except FileNotFoundError as e:
+        print(f"dfslint: no such path: {e}", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(f"dfslint: {e}", file=sys.stderr)
+        return 2
+
+    for f in findings:
+        line = max(1, f.line)
+        if args.style == "gh":
+            level = "error" if f.severity == "error" else "warning"
+            print(f"::{level} file={_gh_prop(f.path)},line={line},"
+                  f"col={max(1, f.col + 1)},title={_gh_prop(f.rule)}::"
+                  f"{_gh_escape(f.message)}")
+        else:
+            print(f"{f.path}:{line}:{max(1, f.col + 1)}: "
+                  f"{f.rule} {f.severity}: {f.message}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

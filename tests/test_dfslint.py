@@ -736,9 +736,13 @@ def test_inline_suppression_same_line_and_comment_above(tmp_path):
         "    time.sleep(1)\n"
         "async def c():\n"
         "    time.sleep(1)  # dfslint: ignore[DFS004]\n")})
-    # a and b are suppressed; c's suppression names the WRONG rule
-    assert rules_of(found) == ["DFS001"]
-    assert found[0].context.startswith("c:")
+    # a and b are suppressed; c's suppression names the WRONG rule —
+    # since r17 that dead suppression is ALSO a DFS000 audit warning
+    assert sorted(rules_of(found)) == ["DFS000", "DFS001"]
+    f001 = next(f for f in found if f.rule == "DFS001")
+    assert f001.context.startswith("c:")
+    f000 = next(f for f in found if f.rule == "DFS000")
+    assert "DFS004" in f000.message and f000.severity == "warning"
 
 
 def test_baseline_accepts_by_stable_key(tmp_path):
@@ -848,6 +852,650 @@ def test_cli_update_baseline_roundtrip(tmp_path):
     bad.write_text(bad.read_text()
                    + "async def b():\n    time.sleep(2)\n")
     assert _cli([str(bad), "--baseline", str(bl)]).returncode == 1
+
+
+# ------------------------------------------------------------------ #
+# phase-1 model (r17): call graph, context inference, lock sets
+# ------------------------------------------------------------------ #
+
+def model_of(tmp_path, files):
+    from scripts.dfslint.core import Project
+    from scripts.dfslint.model import build_model
+
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    from scripts.dfslint import collect_sources
+    project = Project(collect_sources(["."], tmp_path))
+    return build_model(project)
+
+
+def fns_named(model, name):
+    return [fi for fi in model.functions.values() if fi.name == name]
+
+
+def test_model_cross_module_call_edge_and_loop_propagation(tmp_path):
+    """An async def in pkg/a calling an imported sync helper from
+    pkg/b: the model records a module-qualified edge and propagates
+    loop affinity across the file boundary."""
+    m = model_of(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/a.py": ("from pkg.b import helper\n"
+                     "async def main():\n"
+                     "    helper()\n"),
+        "pkg/b.py": "def helper():\n    return 1\n"})
+    (main,) = fns_named(m, "main")
+    (helper,) = fns_named(m, "helper")
+    assert helper.uid in main.callees
+    assert helper.ctx == {"loop"}
+
+
+def test_model_to_thread_laundering_is_worker_not_loop(tmp_path):
+    """`await asyncio.to_thread(work)` seeds work as WORKER and does
+    NOT create a loop-context call edge — the laundering case the
+    affinity propagation must get right."""
+    m = model_of(tmp_path, {"m.py": (
+        "import asyncio\n"
+        "async def main():\n"
+        "    await asyncio.to_thread(work)\n"
+        "def work():\n    return 1\n")})
+    (work,) = fns_named(m, "work")
+    assert work.ctx == {"worker"}
+
+
+def test_model_sync_call_from_both_contexts_is_both(tmp_path):
+    m = model_of(tmp_path, {"m.py": (
+        "import asyncio, threading\n"
+        "async def main():\n"
+        "    shared()\n"
+        "def boot():\n"
+        "    threading.Thread(target=entry).start()\n"
+        "def entry():\n"
+        "    shared()\n"
+        "def shared():\n    return 1\n")})
+    (shared,) = fns_named(m, "shared")
+    assert shared.ctx == {"loop", "worker"}
+
+
+def test_model_thread_target_via_self_method(tmp_path):
+    """Thread(target=self._run) — the r08 heuristic only resolved
+    bare names; the model resolves bound methods."""
+    m = model_of(tmp_path, {"m.py": (
+        "import threading\n"
+        "class J:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._run).start()\n"
+        "    def _run(self):\n"
+        "        return 1\n")})
+    (run,) = fns_named(m, "_run")
+    assert run.ctx == {"worker"}
+
+
+def test_model_trampoline_dispatches_callable_args(tmp_path):
+    """The AsyncChunkStore._run shape: a param reaches an executor via
+    a nested def, so callables at the trampoline's CALL SITES (here a
+    lambda) are worker entry points."""
+    m = model_of(tmp_path, {"m.py": (
+        "import asyncio\n"
+        "class Pool:\n"
+        "    async def _run(self, fn):\n"
+        "        def job():\n"
+        "            return fn()\n"
+        "        loop = asyncio.get_running_loop()\n"
+        "        return await loop.run_in_executor(None, job)\n"
+        "    async def put(self, store):\n"
+        "        return await self._run(lambda: store.put())\n")})
+    lambdas = fns_named(m, "<lambda>")
+    assert any("worker" in fi.ctx for fi in lambdas)
+
+
+def test_model_attr_type_chain_resolution(tmp_path):
+    """to_thread(self.store.manifests.save, …) — the real r13 dispatch
+    shape — resolves through constructor-derived attribute types, two
+    hops deep, across files."""
+    m = model_of(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/store.py": ("class ManifestStore:\n"
+                         "    def save(self, m):\n"
+                         "        return m\n"
+                         "class NodeStore:\n"
+                         "    def __init__(self):\n"
+                         "        self.manifests = ManifestStore()\n"),
+        "pkg/rt.py": ("import asyncio\n"
+                      "from pkg.store import NodeStore\n"
+                      "class Runtime:\n"
+                      "    def __init__(self):\n"
+                      "        self.store = NodeStore()\n"
+                      "    async def announce(self, m):\n"
+                      "        await asyncio.to_thread("
+                      "self.store.manifests.save, m)\n")})
+    (save,) = fns_named(m, "save")
+    assert save.ctx == {"worker"}
+
+
+def test_model_lock_set_extraction_and_inheritance(tmp_path):
+    """Lexical `with self._lock:` guards AND the `*_locked` caller-
+    holds-it convention: a helper whose every call site holds the lock
+    inherits it, so its accesses count as guarded."""
+    m = model_of(tmp_path, {"m.py": (
+        "import threading\n"
+        "class C:\n"
+        "    def bump_locked(self):\n"
+        "        self.n += 1\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self.bump_locked()\n"
+        "    def striped(self, fid):\n"
+        "        with self._mu[0]:\n"
+        "            self.k = fid\n"
+        "    def factory(self, fid):\n"
+        "        with self._lock_for(fid):\n"
+        "            self.j = fid\n")})
+    (bl,) = fns_named(m, "bump_locked")
+    assert "self._lock" in m.inherited_locks(bl)
+    accs = {(a.attr, a.kind): a for a in
+            (x for v in m.accesses.values() for x in v)}
+    assert "self._lock" in accs[("n", "write")].locks      # inherited
+    assert "self._mu" in accs[("k", "write")].locks        # striped
+    assert "self._lock_for" in accs[("j", "write")].locks  # factory
+
+
+def test_dfs001_interprocedural_sync_helper_on_loop(tmp_path):
+    """A sync helper reached ONLY from async context blocks the loop
+    exactly like inline code — the call-graph upgrade of DFS001.
+    Dispatching the same helper through to_thread clears it."""
+    found = lint(tmp_path / "a", {"dfs_tpu/mod.py": (
+        "import time\n"
+        "async def serve():\n"
+        "    helper()\n"
+        "def helper():\n"
+        "    time.sleep(1)\n")})
+    assert rules_of(found) == ["DFS001"]
+    assert "loop-affine" in found[0].message
+    assert lint(tmp_path / "b", {"dfs_tpu/mod.py": (
+        "import asyncio, time\n"
+        "async def serve():\n"
+        "    await asyncio.to_thread(helper)\n"
+        "def helper():\n"
+        "    time.sleep(1)\n")}) == []
+
+
+def test_dfs001_shared_sync_async_helper_not_flagged(tmp_path):
+    """Code-review regression: a helper reached from async code AND
+    from an unclassified sync entry point may legitimately block on
+    the sync path — loop context from one caller is not proof."""
+    assert lint(tmp_path, {"dfs_tpu/mod.py": (
+        "import time\n"
+        "async def serve():\n"
+        "    helper()\n"
+        "def cli_main():\n"
+        "    helper()\n"
+        "def helper():\n"
+        "    time.sleep(1)\n")}) == []
+
+
+def test_model_add_done_callback_is_not_a_loop_seed(tmp_path):
+    """Code-review regression: concurrent.futures runs done-callbacks
+    on the POOL WORKER thread, so the model must leave them
+    unclassified rather than bless them loop-affine."""
+    m = model_of(tmp_path, {"m.py": (
+        "def go(pool):\n"
+        "    fut = pool.submit(work)\n"
+        "    fut.add_done_callback(cb)\n"
+        "def work():\n    return 1\n"
+        "def cb(fut):\n    return fut\n")})
+    (cb,) = fns_named(m, "cb")
+    assert cb.ctx == set()
+
+
+def test_dfs009_locally_owned_buffer_via_name_is_clean(tmp_path):
+    """Code-review regression: `buf = bytearray(n); v = memoryview(buf)`
+    is a view over memory the function OWNS — storing it must not be
+    flagged (only borrowed/pooled sources are)."""
+    assert lint(tmp_path, {"dfs_tpu/comm/own.py": (
+        "class R:\n"
+        "    def arm(self, n):\n"
+        "        buf = bytearray(n)\n"
+        "        v = memoryview(buf)\n"
+        "        self._views.append(v)\n")}) == []
+
+
+def test_dfs010_reused_resp_var_attributes_reads_in_order(tmp_path):
+    """Code-review regression: reads of a REUSED response variable
+    belong to the op bound at that point, not the last one."""
+    files = {
+        "dfs_tpu/comm/rpc.py": (
+            "class Client:\n"
+            "    async def both(self, peer):\n"
+            "        resp, _ = await self.call(peer, {'op': 'a'})\n"
+            "        x = resp.get('xa')\n"
+            "        resp, _ = await self.call(peer, {'op': 'b'})\n"
+            "        return x, resp.get('yb')\n"),
+        "dfs_tpu/node/runtime.py": (
+            "class S:\n"
+            "    async def _dispatch(self, header, body):\n"
+            "        op = header.get('op')\n"
+            "        if op == 'a':\n"
+            "            return {'ok': True, 'xa': 1}, b''\n"
+            "        if op == 'b':\n"
+            "            return {'ok': True, 'yb': 2}, b''\n"
+            "        return {'ok': False, 'error': 'unknown'}, b''\n"),
+        "dfs_tpu/comm/wire.py": (
+            "OP_SPECS = {'a': {'request': [], 'reply': ['xa']},\n"
+            "            'b': {'request': [], 'reply': ['yb']}}\n"),
+    }
+    assert lint(tmp_path, files) == []
+
+
+def test_dfs001_interprocedural_scoped_to_dfs_tpu(tmp_path):
+    """Bench/tool drivers keep the lexical async-def rule only: a sync
+    setup helper blocking outside dfs_tpu/ is not the gated bug
+    class."""
+    assert lint(tmp_path, {"bench_x.py": (
+        "import socket\n"
+        "async def main():\n"
+        "    free_port()\n"
+        "def free_port():\n"
+        "    return socket.socket()\n")}) == []
+
+
+def test_dfs003_trampoline_reaches_loop_affine_call(tmp_path):
+    """The executor-target heuristic is a call-graph fact now: a
+    helper CALLED BY a thread target (not itself a target) touching a
+    loop-affine primitive is flagged too."""
+    found = lint(tmp_path, {"m.py": (
+        "import asyncio, threading\n"
+        "async def run(outq):\n"
+        "    def worker():\n"
+        "        helper(outq)\n"
+        "    await asyncio.to_thread(worker)\n"
+        "def helper(outq):\n"
+        "    outq.put_nowait(1)\n")})
+    assert rules_of(found) == ["DFS003"]
+    assert "helper" in found[0].context
+
+
+# ------------------------------------------------------------------ #
+# DFS008 — thread-affinity race
+# ------------------------------------------------------------------ #
+
+# the r13 ManifestStore resurrection race, minimized: save() runs on
+# CAS worker threads (to_thread), delete mutates the same state from
+# the event loop, no common lock — the shape reviewers hand-caught in
+# round 13, now a fixture the gate must keep catching
+_R13_RACE = (
+    "import asyncio\n"
+    "class ManifestStore:\n"
+    "    def save(self, m):\n"
+    "        if m.file_id in self._tombstones:\n"
+    "            return False\n"
+    "        self._manifests[m.file_id] = m\n"
+    "        return True\n"
+    "    def delete_sync(self, file_id):\n"
+    "        self._tombstones.add(file_id)\n"
+    "        self._manifests.pop(file_id, None)\n"
+    "class Runtime:\n"
+    "    def __init__(self):\n"
+    "        self.store = ManifestStore()\n"
+    "    async def announce(self, m):\n"
+    "        await asyncio.to_thread(self.store.save, m)\n"
+    "    async def delete(self, file_id):\n"
+    "        self.store.delete_sync(file_id)\n")
+
+
+def test_dfs008_flags_minimized_r13_manifest_race(tmp_path):
+    found = lint(tmp_path, {"dfs_tpu/meta/manifest.py": _R13_RACE})
+    assert rules_of(found) == ["DFS008", "DFS008"]
+    assert {f.context for f in found} == {
+        "ManifestStore._manifests:affinity",
+        "ManifestStore._tombstones:affinity"}
+    assert "worker" in found[0].message and "loop" in found[0].message
+
+
+def test_dfs008_common_lock_clears_the_race(tmp_path):
+    """The r13 fix shape: both sides under one (here striped-`_mu`)
+    lock — the model's guard extraction must see it."""
+    fixed = _R13_RACE.replace(
+        "    def save(self, m):\n"
+        "        if m.file_id in self._tombstones:\n"
+        "            return False\n"
+        "        self._manifests[m.file_id] = m\n"
+        "        return True\n",
+        "    def save(self, m):\n"
+        "        with self._mu:\n"
+        "            if m.file_id in self._tombstones:\n"
+        "                return False\n"
+        "            self._manifests[m.file_id] = m\n"
+        "            return True\n").replace(
+        "    def delete_sync(self, file_id):\n"
+        "        self._tombstones.add(file_id)\n"
+        "        self._manifests.pop(file_id, None)\n",
+        "    def delete_sync(self, file_id):\n"
+        "        with self._mu:\n"
+        "            self._tombstones.add(file_id)\n"
+        "            self._manifests.pop(file_id, None)\n")
+    assert lint(tmp_path, {"dfs_tpu/meta/manifest.py": fixed}) == []
+
+
+def test_dfs008_single_context_state_is_clean(tmp_path):
+    """Loop-only state (every toucher on the loop) needs no lock."""
+    assert lint(tmp_path, {"dfs_tpu/x.py": (
+        "class C:\n"
+        "    async def a(self):\n"
+        "        self.n += 1\n"
+        "    async def b(self):\n"
+        "        return self.n\n")}) == []
+
+
+def test_dfs008_init_writes_do_not_count(tmp_path):
+    """Construction precedes sharing: __init__ writes are not a race
+    side even when workers read the attribute later."""
+    assert lint(tmp_path, {"dfs_tpu/x.py": (
+        "import asyncio\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self.cfg = 1\n"
+        "    def job(self):\n"
+        "        return self.cfg\n"
+        "    async def go(self):\n"
+        "        await asyncio.to_thread(self.job)\n")}) == []
+
+
+# ------------------------------------------------------------------ #
+# DFS009 — buffer lifetime / view escape
+# ------------------------------------------------------------------ #
+
+# the r15 staging-buffer recycle bug, minimized: a view over a POOLED
+# staging buffer escapes into state that outlives the recycle guard —
+# refilling the buffer then corrupts the in-flight reference (one
+# flipped tail digest was the observed symptom)
+_R15_RECYCLE = (
+    "class ShardedStager:\n"
+    "    def stage(self, n):\n"
+    "        view = memoryview(self._staging_buf)[:n]\n"
+    "        self._inflight.append(view)\n")
+
+
+def test_dfs009_flags_minimized_r15_staging_recycle(tmp_path):
+    found = lint(tmp_path, {"dfs_tpu/fragmenter/stager.py": _R15_RECYCLE})
+    assert rules_of(found) == ["DFS009"]
+    assert "recycled" in found[0].message
+
+
+def test_dfs009_ownership_copy_is_clean(tmp_path):
+    """The sanctioned fix: copy before the escape (the r10 serve-cache
+    ownership rule)."""
+    fixed = _R15_RECYCLE.replace("append(view)", "append(bytes(view))")
+    assert lint(tmp_path,
+                {"dfs_tpu/fragmenter/stager.py": fixed}) == []
+
+
+def test_dfs009_interprocedural_view_return_hop(tmp_path):
+    """A function returning a pooled view marks its CALLERS' results
+    as borrowed — one call-graph hop, no type inference."""
+    found = lint(tmp_path, {"dfs_tpu/comm/conn.py": (
+        "class Conn:\n"
+        "    def reply_view(self):\n"
+        "        return memoryview(self._rx_pool)[:10]\n"
+        "    def keep(self):\n"
+        "        v = self.reply_view()\n"
+        "        self._saved = v\n")})
+    assert rules_of(found) == ["DFS009"]
+    assert "Conn.keep" in found[0].context
+
+
+def test_dfs009_unpack_chunks_views_must_not_be_cached(tmp_path):
+    """unpack_chunks hands out slices of ONE reply frame; storing one
+    in a cache pins (or outlives) the frame buffer — the enforced
+    version of the r10 annotation."""
+    found = lint(tmp_path, {"dfs_tpu/serve/c2.py": (
+        "from dfs_tpu.comm.wire import unpack_chunks\n"
+        "class Cache:\n"
+        "    def fill(self, table, body):\n"
+        "        for d, mv in unpack_chunks(table, body):\n"
+        "            self._cache[d] = mv\n")})
+    assert rules_of(found) == ["DFS009"]
+
+
+def test_dfs009_owned_buffer_views_are_clean(tmp_path):
+    """A view over a buffer the object OWNS (non-pooled name) may be
+    stored on self — the _FrameReceiver._fmv shape."""
+    assert lint(tmp_path, {"dfs_tpu/comm/recv.py": (
+        "class R:\n"
+        "    def arm(self):\n"
+        "        self._frame = bytearray(64)\n"
+        "        self._fmv = memoryview(self._frame)\n")}) == []
+
+
+def test_dfs009_scoped_to_view_plane(tmp_path):
+    """The same idiom outside the data-plane/staging modules (CLI,
+    ops kernels) is not in scope."""
+    assert lint(tmp_path, {"dfs_tpu/cli/x.py": (
+        "class C:\n"
+        "    def f(self, b):\n"
+        "        v = memoryview(self._staging_buf)\n"
+        "        self._keep.append(v)\n")}) == []
+
+
+# ------------------------------------------------------------------ #
+# DFS010 — wire-protocol contract
+# ------------------------------------------------------------------ #
+
+_WIRE_RPC = (
+    "class Client:\n"
+    "    async def ping(self, peer, tok):\n"
+    "        resp, _ = await self.call(peer, {'op': 'ping', "
+    "'token': tok})\n"
+    "        return resp.get('pong')\n")
+_WIRE_RT = (
+    "class S:\n"
+    "    async def _dispatch(self, header, body):\n"
+    "        op = header.get('op')\n"
+    "        if op == 'ping':\n"
+    "            return {'ok': True, 'pong': header.get('token')}, b''\n"
+    "        return {'ok': False, 'error': 'unknown'}, b''\n")
+_WIRE_SPECS = ("OP_SPECS = {'ping': {'request': ['token'], "
+               "'reply': ['pong']}}\n")
+_WIRE_BASE = {"dfs_tpu/comm/rpc.py": _WIRE_RPC,
+              "dfs_tpu/node/runtime.py": _WIRE_RT,
+              "dfs_tpu/comm/wire.py": _WIRE_SPECS}
+
+
+def test_dfs010_clean_three_way_agreement(tmp_path):
+    assert lint(tmp_path, dict(_WIRE_BASE)) == []
+
+
+def test_dfs010_sent_but_unhandled_op_fails(tmp_path):
+    files = dict(_WIRE_BASE)
+    files["dfs_tpu/comm/rpc.py"] = _WIRE_RPC + (
+        "    async def zap(self, peer):\n"
+        "        await self.call(peer, {'op': 'zap'})\n")
+    found = lint(tmp_path, files)
+    assert rules_of(found) == ["DFS010"]
+    assert found[0].context == "wire:zap:unhandled"
+    assert "unknown op" in found[0].message
+
+
+def test_dfs010_handled_but_undocumented_op_fails(tmp_path):
+    files = dict(_WIRE_BASE)
+    files["dfs_tpu/node/runtime.py"] = _WIRE_RT.replace(
+        "        return {'ok': False, 'error': 'unknown'}, b''\n",
+        "        if op == 'zap':\n"
+        "            return {'ok': True}, b''\n"
+        "        return {'ok': False, 'error': 'unknown'}, b''\n")
+    found = lint(tmp_path, files)
+    assert rules_of(found) == ["DFS010"]
+    assert found[0].context == "wire:zap:undocumented"
+
+
+def test_dfs010_documented_but_unhandled_op_fails(tmp_path):
+    files = dict(_WIRE_BASE)
+    files["dfs_tpu/comm/wire.py"] = (
+        "OP_SPECS = {'ping': {'request': ['token'], 'reply': ['pong']},"
+        " 'ghost': {'request': [], 'reply': []}}\n")
+    found = lint(tmp_path, files)
+    assert rules_of(found) == ["DFS010"]
+    assert found[0].context == "wire:ghost:doc-unhandled"
+
+
+def test_dfs010_reply_field_read_but_never_produced(tmp_path):
+    files = dict(_WIRE_BASE)
+    files["dfs_tpu/comm/rpc.py"] = _WIRE_RPC.replace(
+        "resp.get('pong')", "resp.get('nope')")
+    found = lint(tmp_path, files)
+    assert "wire:ping:reply:nope" in {f.context for f in found}
+
+
+def test_dfs010_request_field_read_but_never_sent(tmp_path):
+    files = dict(_WIRE_BASE)
+    files["dfs_tpu/node/runtime.py"] = _WIRE_RT.replace(
+        "return {'ok': True, 'pong': header.get('token')}, b''",
+        "return {'ok': True, 'pong': header.get('token'), "
+        "'extra': header.get('extra')}, b''")
+    found = lint(tmp_path, files)
+    assert "wire:ping:req:extra" in {f.context for f in found}
+
+
+def test_dfs010_missing_specs_table_is_one_finding(tmp_path):
+    files = dict(_WIRE_BASE)
+    files["dfs_tpu/comm/wire.py"] = "MAGIC = 1\n"
+    found = lint(tmp_path, files)
+    assert rules_of(found) == ["DFS010"]
+    assert found[0].context == "wire:<no-specs>"
+
+
+def test_dfs010_real_tree_full_op_coverage():
+    """Acceptance: client/server/docs agree for EVERY internal op —
+    including r16's get_filter/filter_delta — on the real tree."""
+    from scripts.dfslint.core import Project
+    from scripts.dfslint import collect_sources
+    from scripts.dfslint.rules import _wire_handlers, _wire_specs
+
+    project = Project(collect_sources(
+        ["dfs_tpu/node/runtime.py", "dfs_tpu/comm/wire.py"], REPO))
+    handlers = _wire_handlers(project.find("dfs_tpu/node/runtime.py"))
+    specs = _wire_specs(project.find("dfs_tpu/comm/wire.py"))
+    assert handlers and specs
+    assert set(handlers) == set(specs)
+    assert {"get_filter", "filter_delta"} <= set(specs)
+
+
+# ------------------------------------------------------------------ #
+# DFS000 — stale-suppression / stale-baseline audit
+# ------------------------------------------------------------------ #
+
+def test_stale_suppression_is_a_warning(tmp_path):
+    found = lint(tmp_path, {"mod.py": "x = 1  # dfslint: ignore[DFS001]\n"})
+    assert rules_of(found) == ["DFS000"]
+    assert found[0].severity == "warning"
+    assert "stale suppression" in found[0].message
+
+
+def test_live_suppression_is_not_flagged(tmp_path):
+    found = lint(tmp_path, {"mod.py": (
+        "import time\n"
+        "async def a():\n"
+        "    time.sleep(1)  # dfslint: ignore[DFS001]\n")})
+    assert found == []
+
+
+def test_quoted_suppression_syntax_is_not_a_suppression(tmp_path):
+    """Docstrings and prose quoting `# dfslint: ignore[...]` must
+    neither suppress nor be audited as stale."""
+    found = lint(tmp_path, {"mod.py": (
+        '"""Docs: suppress with `# dfslint: ignore[DFS001]`."""\n'
+        "# quoting `# dfslint: ignore[DFS004]` in prose is fine\n"
+        "x = 1\n")})
+    assert found == []
+
+
+def test_stale_baseline_entry_is_a_warning(tmp_path):
+    found = lint(tmp_path, {"mod.py": "x = 1\n"},
+                 baseline={"DFS001:mod.py:gone:time.sleep"})
+    assert rules_of(found) == ["DFS000"]
+    assert "stale baseline" in found[0].message
+    # a key whose path was NOT scanned is skipped (narrowed runs must
+    # not false-flag what they cannot judge)
+    found = lint(tmp_path, {},
+                 baseline={"DFS001:elsewhere.py:gone:time.sleep"})
+    assert found == []
+
+
+def test_update_baseline_never_accepts_dfs000(tmp_path):
+    """--update-baseline prunes stale entries and must NOT accept the
+    audit's own warnings — baselining rot would re-create it."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("x = 1  # dfslint: ignore[DFS001]\n")
+    bl = tmp_path / "bl.json"
+    r = _cli([str(bad), "--baseline", str(bl), "--update-baseline"])
+    assert r.returncode == 0, r.stderr
+    assert json.loads(bl.read_text())["accepted"] == []
+    # the stale suppression still gates after the update
+    assert _cli([str(bad), "--baseline", str(bl)]).returncode == 1
+
+
+# ------------------------------------------------------------------ #
+# --stats, --format sarif, and the tier-1 wall-clock budget
+# ------------------------------------------------------------------ #
+
+def test_cli_stats_json_breakdown(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    r = _cli([str(ok), "--json", "--stats"])
+    out = json.loads(r.stdout)
+    assert out["stats"]["files"] == 1
+    phases = out["stats"]["phases"]
+    assert "model" in phases and "DFS008" in phases and "audit" in phases
+    assert out["stats"]["totalS"] >= phases["model"]
+
+
+def test_cli_sarif_output(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nasync def a():\n    time.sleep(1)\n")
+    r = _cli([str(bad), "--format", "sarif"])
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "dfslint"
+    assert {rule["id"] for rule in run["tool"]["driver"]["rules"]} \
+        >= {"DFS001", "DFS008", "DFS009", "DFS010"}
+    res = run["results"][0]
+    assert res["ruleId"] == "DFS001" and res["level"] == "error"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] == 3
+
+
+def test_annotation_hook_emits_file_line_annotations(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nasync def a():\n    time.sleep(1)\n")
+    r = subprocess.run(
+        [sys.executable, "scripts/dfslint_annotate.py", str(bad)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert r.stdout.startswith("::error file=")
+    assert ",line=3," in r.stdout and "title=DFS001" in r.stdout
+    r = subprocess.run(
+        [sys.executable, "scripts/dfslint_annotate.py", "--style",
+         "plain", str(bad)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert ":3:" in r.stdout and "DFS001 error:" in r.stdout
+
+
+def test_full_run_within_wall_clock_budget():
+    """Acceptance gate: the full run — interprocedural model included —
+    stays within 2x the pre-PR lint wall-clock, measured by --stats.
+    Pre-PR (r16 rules, this host): 1.69 s CLI wall; the absolute bound
+    is 2x that, and the host-independent bound says the phase-1 model
+    + new rules may at most DOUBLE the legacy phases' cost."""
+    stats: dict = {}
+    analyze(list(DEFAULT_ROOTS), REPO,
+            baseline=load_baseline(DEFAULT_BASELINE), stats=stats)
+    phases = stats["phases"]
+    legacy = stats["walkS"] + sum(
+        phases.get(f"DFS00{i}", 0.0) for i in range(1, 8))
+    assert stats["totalS"] <= max(3.4, 2.0 * legacy), stats
 
 
 # ------------------------------------------------------------------ #

@@ -743,3 +743,22 @@ def test_bench_dedup_index_tiny_smoke(tmp_path):
     assert g["crash_mid_compaction"]["ok"]
     assert g["crash_mid_compaction"]["ackedFilesIntact"]
     assert g["crash_mid_compaction"]["indexMatchesWalk"]
+
+
+def test_lsi_open_info_runs_count_reported_under_lock(tmp_path):
+    """r17 DFS008 regression: open_or_rebuild's run-list length moved
+    under the store lock (nothing pins the open to run before workers
+    start); the reported count must still match the persisted runs."""
+    idx = DigestIndex(tmp_path / "ix", memtable_entries=256,
+                      compact_runs=64)
+    idx.open_or_rebuild(lambda: [])
+    for d in _digests(600, "r"):
+        idx.note_put(d)            # memtable flushes => persisted runs
+    idx.close()
+    idx2 = DigestIndex(tmp_path / "ix", memtable_entries=256,
+                       compact_runs=64)
+    info = idx2.open_or_rebuild(lambda: [])
+    cur = json.loads((tmp_path / "ix" / "CURRENT").read_bytes())
+    assert info["rebuilt"] is False
+    assert info["runs"] == len(cur["runs"]) and info["runs"] > 0
+    idx2.close()

@@ -147,3 +147,50 @@ def test_put_falls_back_to_replace_without_hardlinks(tmp_path, monkeypatch):
     else:
         raise AssertionError("non-hardlink errno must propagate")
     monkeypatch.setattr(os, "link", real_link)
+
+
+def test_count_gauge_primes_once_and_tracks_put_delete(tmp_path):
+    """r17 DFS008 regression: count()'s lazily-primed gauge peek moved
+    under the lock (it raced the worker-side put/delete updates); the
+    prime-once-then-maintain contract — and the priming scan staying
+    OUTSIDE the lock — must survive the restructure."""
+    store = ChunkStore(tmp_path / "chunks")
+    payloads = [b"a" * 10, b"b" * 20, b"c" * 30]
+    digests = [sha256_hex(p) for p in payloads]
+    for d, p in zip(digests, payloads):
+        store.put(d, p)
+    assert store.count() == 3                  # priming scan
+    store.delete(digests[0])
+    assert store.count() == 2                  # maintained, no rescan
+    d_new = sha256_hex(b"d" * 5)
+    store.put(d_new, b"d" * 5)
+    store.put(d_new, b"d" * 5)                 # dedup hit: no double count
+    assert store.count() == 3
+    assert store.bytes_total() == 20 + 30 + 5
+
+    # the gauges stay coherent when hammered from worker threads while
+    # a reader polls — the cross-context shape DFS008 flagged
+    import threading
+
+    extra = [(sha256_hex(bytes([i]) * 8), bytes([i]) * 8)
+             for i in range(32)]
+    seen = []
+
+    def writer(items):
+        for d, p in items:
+            store.put(d, p)
+
+    def reader():
+        for _ in range(64):
+            seen.append((store.count(), store.bytes_total()))
+
+    threads = [threading.Thread(target=writer, args=(extra[:16],)),
+               threading.Thread(target=writer, args=(extra[16:],)),
+               threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert store.count() == 3 + 32
+    assert store.bytes_total() == 20 + 30 + 5 + 32 * 8
+    assert all(c >= 3 and b >= 55 for c, b in seen)

@@ -23,6 +23,8 @@ import json
 from typing import TYPE_CHECKING
 from urllib.parse import parse_qs, unquote, urlsplit
 
+from dfs_tpu.utils import deadline
+
 if TYPE_CHECKING:
     from dfs_tpu.node.runtime import StorageNodeServer
 
@@ -102,6 +104,20 @@ def _shed(node: "StorageNodeServer", e) -> bytes:
     node.counters.inc("http_shed")
     return _resp(503, str(e).encode(), "text/plain; charset=utf-8",
                  {"Retry-After": str(max(1, _math.ceil(e.retry_after_s)))})
+
+
+def _deadline_503(node: "StorageNodeServer", e) -> bytes:
+    """503 + Retry-After for a deadline that died AFTER admission: the
+    same answer the gate gives an expired arrival — never a 500, which
+    would invite exactly the immediate no-backoff retry the Retry-After
+    discipline exists to prevent (the cluster is healthy; the caller's
+    budget is not)."""
+    import math as _math
+
+    node.counters.inc("http_shed")
+    return _resp(503, str(e).encode(), "text/plain; charset=utf-8",
+                 {"Retry-After": str(max(1, _math.ceil(
+                     node.cfg.serve.retry_after_s)))})
 
 
 class _GatedBody:
@@ -244,6 +260,16 @@ _TRACED_ROUTES = frozenset({
     "/trace", "/events", "/doctor", "/census", "/metrics/history",
     "/chaos", "/ring"})
 
+# routes the CONFIGURED default deadline applies to: the client-facing
+# data plane. Maintenance/diagnosis endpoints (/repair, /scrub,
+# /census, /doctor …) are deliberately exempt — an operator-requested
+# healing pass capped at the traffic deadline would abort partway
+# through exactly the backlog it was asked to clear. An EXPLICIT
+# X-Dfs-Deadline header is honored on any route (the caller asked).
+_DEADLINE_DEFAULT_ROUTES = frozenset({
+    "/download", "/upload", "/upload_resume", "/missing", "/chunking",
+    "/manifest", "/files"})
+
 
 async def _serve_one(node: "StorageNodeServer",
                      reader: asyncio.StreamReader) -> bytes:
@@ -263,6 +289,7 @@ async def _serve_one(node: "StorageNodeServer",
     content_length: int | None = None
     range_header: str | None = None
     trace_header: str | None = None
+    deadline_header: str | None = None
     chunked = False
     while True:
         line = (await reader.readline()).decode("latin-1")
@@ -287,10 +314,27 @@ async def _serve_one(node: "StorageNodeServer",
                 # "<trace32hex>-<span16hex>"; absent or malformed simply
                 # roots a fresh trace — a bad header never fails a request
                 trace_header = v.strip()
+            elif key == "x-dfs-deadline":
+                # end-to-end deadline carrier (docs/serve.md §deadlines):
+                # remaining budget in seconds; absent or malformed means
+                # no client deadline — the default-deadline config (or
+                # nothing) applies, never an error
+                deadline_header = v.strip()
             elif key == "transfer-encoding":
                 chunked = "chunked" in v.strip().lower()
 
     node.counters.inc("http_requests")
+
+    # deadline born at the edge: the client's X-Dfs-Deadline budget, or
+    # the configured default for clients that sent none. Carried in a
+    # contextvar exactly like the trace context, so every downstream hop
+    # (admission waits, RPC calls, CAS pool jobs) inherits it. Both
+    # absent (the default config) = no deadline = pre-r18 behavior.
+    budget = deadline.parse_header(deadline_header)
+    if budget is None and path in _DEADLINE_DEFAULT_ROUTES \
+            and node.cfg.serve.default_deadline_s > 0:
+        budget = node.cfg.serve.default_deadline_s
+    dl_token = deadline.activate(budget) if budget is not None else None
 
     # the request span: every downstream hop (rpc calls, CAS pool jobs,
     # admission waits) inherits its context via contextvars and parents
@@ -300,24 +344,40 @@ async def _serve_one(node: "StorageNodeServer",
     # latency=True: per-route histograms (bounded: allowlisted routes +
     # http.other) whose buckets carry the request's trace id as an
     # OpenMetrics exemplar — /metrics links a slow bucket to `trace <id>`
-    with node.obs.request_span(name, parse_http_trace(trace_header),
-                               latency=True) as sp:
-        out = await _route(node, reader, method, path, query,
-                           content_length, range_header, chunked)
-        if isinstance(out, (bytes, bytearray)):
-            sp.bytes = len(out)
-        elif isinstance(out, list):             # vectored response
-            sp.bytes = sum(len(p) for p in out)
-        return out
+    streamed = False
+    try:
+        with node.obs.request_span(name, parse_http_trace(trace_header),
+                                   latency=True) as sp:
+            out = await _route(node, reader, method, path, query,
+                               content_length, range_header, chunked)
+            # a (head, body_gen) tuple is a streamed download: the
+            # handler iterates the body in THIS task after we return,
+            # and the generator's per-batch _fetch_verified deadline
+            # checks must keep seeing the countdown — so the context
+            # is deliberately NOT restored (it dies with the handler
+            # task; the connection serves exactly one request).
+            # Restoring here silently disarmed mid-download expiry for
+            # every batch after the first (r18 review finding).
+            streamed = isinstance(out, tuple)
+            if isinstance(out, (bytes, bytearray)):
+                sp.bytes = len(out)
+            elif isinstance(out, list):             # vectored response
+                sp.bytes = sum(len(p) for p in out)
+            return out
+    finally:
+        if dl_token is not None and not streamed:
+            deadline.restore(dl_token)
 
 
 async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
                  method: str, path: str, query: dict,
                  content_length: int | None, range_header: str | None,
                  chunked: bool):
-    from dfs_tpu.node.runtime import (DownloadError, NotFoundError,
-                                      RangeNotSatisfiable, UploadError)
-    from dfs_tpu.serve import ShedError
+    from dfs_tpu.comm.rpc import DeadlineExpired
+    from dfs_tpu.node.runtime import (DeadlineExceeded, DownloadError,
+                                      NotFoundError, RangeNotSatisfiable,
+                                      UploadError)
+    from dfs_tpu.serve import ClientDisconnected, ShedError
 
     if method == "GET" and path == "/status":
         return plain(200, "OK")  # exact reference reply, StorageNode.java:73
@@ -555,6 +615,8 @@ async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
             try:
                 manifest, stats = await node.upload_resume(
                     table, query.get("name", ""), file_id, size, provided)
+            except (DeadlineExpired, DeadlineExceeded) as e:
+                return _deadline_503(node, e)
             except UploadError as e:
                 # 409 = resume no longer possible (client falls back to a
                 # full upload); 400 = bad frame/table; 500 = placement
@@ -618,9 +680,19 @@ async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
                 rng = None
         gate = node.serve.admission.download
         try:
-            await gate.acquire()
+            # disconnect watcher: a GET has no body, so the only thing
+            # this read can ever return is b"" (EOF — the client hung
+            # up) or stray garbage; the gate frees our queue position
+            # on EOF so an abandoned download never consumes a slot
+            # when it reaches the head (docs/serve.md)
+            await gate.acquire(disconnected=lambda: reader.read(1))
         except ShedError as e:
             return _shed(node, e)
+        except ClientDisconnected:
+            # nobody left to answer; the handler's write of b"" is a
+            # no-op on the dead socket
+            node.counters.inc("http_client_gone")
+            return b""
         streaming = None
         try:
             if rng is not None:
@@ -650,6 +722,10 @@ async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
             return binary_head(200, manifest.size, manifest.name), streaming
         except NotFoundError:
             return plain(404, "File not found")
+        except DeadlineExceeded as e:
+            # the budget died post-admission, pre-head: same answer as
+            # an expired arrival at the gate
+            return _deadline_503(node, e)
         except DownloadError as e:
             return plain(500, str(e))
         finally:
@@ -688,7 +764,8 @@ async def _handle_upload(node: "StorageNodeServer",
                          ec_k: int) -> bytes:
     """POST /upload body handling (factored out so the admission gate
     wraps it in one try/finally)."""
-    from dfs_tpu.node.runtime import UploadError
+    from dfs_tpu.comm.rpc import DeadlineExpired
+    from dfs_tpu.node.runtime import DeadlineExceeded, UploadError
 
     if chunked or (content_length > STREAM_BODY_BYTES and not ec_k):
         # streaming ingest: the body feeds the fragmenter's
@@ -711,6 +788,11 @@ async def _handle_upload(node: "StorageNodeServer",
         try:
             manifest, stats = await node.upload_stream(
                 body, query.get("name", ""))
+        except (DeadlineExpired, DeadlineExceeded) as e:
+            # the caller's budget died mid-placement: a 503-class
+            # refusal (already-placed chunks age out via GC; a later
+            # retry dedups them) — see _deadline_503
+            return _deadline_503(node, e)
         except UploadError as e:
             return plain(getattr(e, "status", 500), str(e))
         except ValueError as e:
@@ -720,6 +802,8 @@ async def _handle_upload(node: "StorageNodeServer",
         try:
             manifest, stats = await node.upload(
                 data, query.get("name", ""), ec_k=ec_k)
+        except (DeadlineExpired, DeadlineExceeded) as e:
+            return _deadline_503(node, e)
         except UploadError as e:
             # "Replication failed" -> 500 (:176); ec validation -> 400
             return plain(getattr(e, "status", 500), str(e))

@@ -64,7 +64,11 @@ def cmd_serve(args) -> int:
                           upload_slots=args.upload_slots,
                           internal_slots=args.internal_slots,
                           queue_depth=args.queue_depth,
-                          retry_after_s=args.retry_after),
+                          retry_after_s=args.retry_after,
+                          default_deadline_s=args.default_deadline,
+                          hedge_floor_s=args.hedge_floor,
+                          hedge_cap_s=args.hedge_cap,
+                          hedge_budget_per_s=args.hedge_budget),
         ingest=IngestConfig(window=args.ingest_window,
                             flush_bytes=args.ingest_flush_bytes,
                             credit_bytes=args.ingest_credit_bytes,
@@ -556,6 +560,18 @@ def build_parser() -> argparse.ArgumentParser:
                             "(store/get chunks); 0 = unbounded")
     serve.add_argument("--queue-depth", type=int, default=64,
                        help="waiters beyond the slots before 503 shedding")
+    serve.add_argument("--default-deadline", type=float, default=0.0,
+                       help="end-to-end deadline (seconds) stamped on "
+                            "HTTP requests without an X-Dfs-Deadline "
+                            "header; 0 = none (docs/serve.md)")
+    serve.add_argument("--hedge-floor", type=float, default=0.02,
+                       help="minimum hedged-read delay (seconds) before "
+                            "a second replica is asked")
+    serve.add_argument("--hedge-cap", type=float, default=0.5,
+                       help="maximum hedged-read delay (seconds)")
+    serve.add_argument("--hedge-budget", type=float, default=0.0,
+                       help="hedge token-bucket refill per second; "
+                            "0 disables hedged reads (the default)")
     serve.add_argument("--sidecar-port", type=int, default=None,
                        help="delegate chunk+hash to a running sidecar "
                             "process (overrides --fragmenter)")

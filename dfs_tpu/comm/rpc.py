@@ -28,6 +28,7 @@ from typing import Any
 from dfs_tpu.comm.wire import (Buffer, FrameConnection, WireError,
                                buffers_nbytes, pack_chunks, unpack_chunks)
 from dfs_tpu.config import PeerAddr
+from dfs_tpu.utils import deadline
 from dfs_tpu.utils.aio import gather_abort_siblings
 
 
@@ -43,6 +44,14 @@ class RpcUnreachable(RpcError):
 class RpcRemoteError(RpcError):
     """The peer was reachable and answered with an application-level error
     (e.g. chunk not found). Says nothing about peer liveness."""
+
+
+class DeadlineExpired(RpcError):
+    """The caller's end-to-end deadline ran out before (or between)
+    attempts — the work is dead, so no frame is sent and no retry is
+    paid (docs/serve.md §deadlines). An RpcError, NOT RpcUnreachable:
+    an expired budget says nothing about peer liveness, and the retry
+    loop's application-error fast path stops on it by construction."""
 
 
 class RingEpochMismatch(RpcRemoteError):
@@ -235,6 +244,20 @@ class InternalClient:
                          timeout_s: float | None = None,
                          acct: dict | None = None
                          ) -> tuple[dict, memoryview]:
+        rem = deadline.remaining()
+        if rem is not None:
+            if rem <= 0:
+                # expired work must never reach the wire (or, on the
+                # receiving side, a worker thread)
+                raise DeadlineExpired(
+                    f"peer {peer.node_id}: deadline expired before send")
+            # remaining budget rides the OPTIONAL `deadline` header
+            # field, re-stamped per attempt so every hop (and every
+            # retry) carries what is actually left — the hop decrement
+            # falls out of sending REMAINING time, not absolute time.
+            # Pre-r18 peers ignore unknown header fields (the `trace`
+            # compatibility contract, comm/wire.py).
+            header["deadline"] = round(rem, 4)
         chaos = self._chaos
         if chaos is not None:
             op = str(header.get("op"))
@@ -455,13 +478,28 @@ class InternalClient:
             # not silent: the retry is metered (rpc_client.retry) and
             # journaled (rpc_retry) at the top of the next attempt, and
             # the terminal failure emits rpc_unreachable + raises
-            except (OSError, asyncio.TimeoutError, RuntimeError) as e:  # dfslint: ignore[DFS007]
+            except (OSError, asyncio.TimeoutError, RuntimeError) as e:
                 last = e
                 if attempt + 1 < attempts:
                     prev_sleep = min(
                         self._BACKOFF_CAP_S,
                         self._backoff_rng.uniform(self._BACKOFF_BASE_S,
                                                   3.0 * prev_sleep))
+                    rem = deadline.remaining()
+                    if rem is not None \
+                            and rem < prev_sleep + self.connect_timeout_s:
+                        # the remaining budget cannot cover the backoff
+                        # plus even a connect — another attempt is pure
+                        # waste aimed at a caller that will be gone
+                        if self._obs is not None:
+                            self._obs.event("deadline_shed",
+                                            where="rpc_retry",
+                                            peer=peer.node_id,
+                                            op=str(op), attempt=attempt)
+                        raise DeadlineExpired(
+                            f"peer {peer.node_id} {op}: deadline cannot "
+                            f"cover another attempt ({rem:.3f}s left): "
+                            f"{type(e).__name__}: {e}") from e
                     await asyncio.sleep(prev_sleep)
         if self._obs is not None:
             self._obs.event("rpc_unreachable", peer=peer.node_id,

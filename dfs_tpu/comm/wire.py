@@ -22,6 +22,16 @@ unknown header keys, and receivers that understand it treat a frame
 without (or with a malformed) ``trace`` exactly like one from an
 untraced caller. The field never affects op semantics.
 
+Since round 18 the header MAY also carry an OPTIONAL ``deadline``
+field — the sender's REMAINING end-to-end budget in seconds (a JSON
+number; docs/serve.md §deadlines). Remaining time, never absolute wall
+time: the receiver starts its own countdown on arrival, so the hop
+decrement is exactly the network flight time and no clock comparison
+ever crosses processes. Same bidirectional compatibility contract as
+``trace``: absent/malformed = an undeadlined caller (pre-r18 peer),
+and the field never changes what an op DOES — only whether a receiver
+may refuse to start work whose caller has already given up.
+
 Round 10 makes the frame layer **zero-copy** (docs/wire.md):
 
 - a body may be a *sequence of buffers* (``bytes | bytearray |
@@ -71,10 +81,11 @@ MAX_BODY = 8 * 1024 * 1024 * 1024
 # The internal-op contract, as data. One entry per op the storage plane
 # speaks: the request header fields a client may send and the reply
 # header fields a handler may produce — beyond the envelope the
-# transport owns (`op`, optional `trace`, the ring-epoch pair
-# `repoch`/`rfp` on placement-bearing ops, and `ok`/`error` plus the
-# `ringEpoch`/`ring` refusal pair on every reply). `body` notes the
-# binary payload direction for humans; the checker does not model it.
+# transport owns (`op`, optional `trace`, the optional remaining-budget
+# `deadline`, the ring-epoch pair `repoch`/`rfp` on placement-bearing
+# ops, and `ok`/`error` plus the `ringEpoch`/`ring` refusal pair on
+# every reply). `body` notes the binary payload direction for humans;
+# the checker does not model it.
 #
 # dfslint DFS010 (docs/lint.md) statically extracts the op set from the
 # client call sites (comm/rpc.py + the runtime's raw sends) and the

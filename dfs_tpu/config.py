@@ -204,6 +204,20 @@ class ServeConfig:
     internal_slots: int = 0     # concurrent storage-plane ops budget
     queue_depth: int = 64       # waiters beyond the slots before shedding
     retry_after_s: float = 1.0  # advertised in 503 Retry-After
+    default_deadline_s: float = 0.0  # end-to-end deadline stamped on
+                                # HTTP requests without an X-Dfs-Deadline
+                                # header (docs/serve.md §deadlines);
+                                # 0 = none — pre-r18 behavior exactly
+    hedge_floor_s: float = 0.02  # minimum hedge delay: never hedge a
+                                # read sooner than this (a hedge below
+                                # the healthy RTT doubles every fetch)
+    hedge_cap_s: float = 0.5    # maximum hedge delay: a replica slower
+                                # than this is hedged even if its
+                                # history says it used to be slower
+    hedge_budget_per_s: float = 0.0  # hedge token-bucket refill per
+                                # second (serve/hedge.py); the MASTER
+                                # switch — 0 = hedged reads off (the
+                                # default: pre-r18 read path exactly)
 
     def __post_init__(self) -> None:
         if self.cache_bytes < 0:
@@ -212,6 +226,12 @@ class ServeConfig:
             raise ValueError("readahead_batches must be >= 0")
         if self.queue_depth < 0:
             raise ValueError("queue_depth must be >= 0")
+        if self.default_deadline_s < 0:
+            raise ValueError("default_deadline_s must be >= 0")
+        if self.hedge_floor_s < 0 or self.hedge_cap_s < self.hedge_floor_s:
+            raise ValueError("need 0 <= hedge_floor_s <= hedge_cap_s")
+        if self.hedge_budget_per_s < 0:
+            raise ValueError("hedge_budget_per_s must be >= 0")
 
 
 @dataclasses.dataclass(frozen=True)

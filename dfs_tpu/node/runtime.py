@@ -35,8 +35,8 @@ import types
 from collections import deque
 from typing import Mapping, Sequence
 
-from dfs_tpu.comm.rpc import (InternalClient, RpcError, RpcRemoteError,
-                              RpcUnreachable)
+from dfs_tpu.comm.rpc import (DeadlineExpired, InternalClient, RpcError,
+                              RpcRemoteError, RpcUnreachable)
 from dfs_tpu.comm.wire import (FrameServerProtocol, WireError, encode_frame,
                                pack_chunks, unpack_chunks)
 from dfs_tpu.config import NodeConfig
@@ -50,6 +50,7 @@ from dfs_tpu.ring.manager import RingManager
 from dfs_tpu.serve import BatchPrefetcher, ServingTier
 from dfs_tpu.store.aio import AsyncChunkStore
 from dfs_tpu.store.cas import NodeStore
+from dfs_tpu.utils import deadline
 from dfs_tpu.utils.hashing import (is_hex_digest, sha256_hex,
                                    sha256_many_hex, sha256_new)
 from dfs_tpu.utils.aio import create_logged_task, gather_abort_siblings
@@ -83,6 +84,16 @@ class RangeNotSatisfiable(DownloadError):
     def __init__(self, size: int) -> None:
         super().__init__(f"range not satisfiable (size {size})")
         self.size = size
+
+
+class DeadlineExceeded(DownloadError):
+    """The caller's end-to-end deadline expired during a read — maps to
+    HTTP 503 + Retry-After (the same answer the admission gate gives an
+    expired arrival), never a 500: the cluster is healthy, the budget
+    is gone, and a 500 would invite the immediate no-backoff retry the
+    Retry-After discipline exists to prevent. Also distinct so the
+    fetch walks can STOP at expiry instead of touring every remaining
+    candidate and counting each refusal as a remote miss."""
 
 
 def ec_placement_map(manifest: Manifest, ring) -> Mapping[str, tuple[int, ...]]:
@@ -527,6 +538,11 @@ class StorageNodeServer:
                            "rebalance-kick")
 
     async def _rebalance_kick(self) -> None:
+        # the kick may have been spawned from inside a deadlined RPC's
+        # dispatch (epoch adoption off a placement-bearing call):
+        # create_task copied that context, and a rebalance walk must
+        # not inherit a request's dying budget
+        deadline.clear()
         try:
             await self.repair_once()
         except Exception as e:  # noqa: BLE001 — next periodic repair
@@ -793,44 +809,59 @@ class StorageNodeServer:
         gate)."""
         op = header.get("op")
         tr = parse_wire_trace(header.get("trace"))
+        # end-to-end deadline off the wire (docs/serve.md §deadlines):
+        # the OPTIONAL `deadline` field carries the sender's REMAINING
+        # budget — this hop starts its own countdown from it, so the
+        # decrement across hops is exactly the flight time and no wall
+        # clocks are ever compared. Absent/malformed (pre-r18 peer) =
+        # no deadline, the historical service path byte-identical.
+        budget = deadline.parse_wire(header.get("deadline"))
+        dl_token = deadline.activate(budget) if budget is not None \
+            else None
         t0 = time.perf_counter()
-        with (self.obs.server_span(f"peer.{op}", tr)
-              if tr is not None or op in _HEAVY_OPS
-              else contextlib.nullcontext(_NULL_OBS_SPAN)) as sp:
-            sp.bytes = nbytes_in
-            try:
-                if self.chaos is not None:
-                    # injected whole-node slowness (chaos serve_delay):
-                    # inside the span so traces attribute the stall to
-                    # this op, before the gate so probes feel it too —
-                    # a slow node's health answers ARE slow
-                    await self.chaos.before_serve(str(op))
-                gate = self.serve.admission.internal
-                if gate.enabled and op in _HEAVY_OPS:
-                    # bounded storage-plane concurrency for the
-                    # BULK ops only; a shed op surfaces to the
-                    # peer as an application error
-                    # (RpcRemoteError — live peer, not a death
-                    # sign). Cheap O(1)/metadata ops — health
-                    # above all — bypass the gate: a health
-                    # probe queued behind multi-second transfers
-                    # past the prober's timeout would make a
-                    # merely BUSY node look dead and trigger
-                    # repair churn.
-                    async with gate.slot():
+        try:
+            with (self.obs.server_span(f"peer.{op}", tr)
+                  if tr is not None or op in _HEAVY_OPS
+                  else contextlib.nullcontext(_NULL_OBS_SPAN)) as sp:
+                sp.bytes = nbytes_in
+                try:
+                    if self.chaos is not None:
+                        # injected whole-node slowness (chaos
+                        # serve_delay): inside the span so traces
+                        # attribute the stall to this op, before the
+                        # gate so probes feel it too — a slow node's
+                        # health answers ARE slow
+                        await self.chaos.before_serve(str(op))
+                    gate = self.serve.admission.internal
+                    if gate.enabled and op in _HEAVY_OPS:
+                        # bounded storage-plane concurrency for the
+                        # BULK ops only; a shed op surfaces to the
+                        # peer as an application error
+                        # (RpcRemoteError — live peer, not a death
+                        # sign). Cheap O(1)/metadata ops — health
+                        # above all — bypass the gate: a health
+                        # probe queued behind multi-second transfers
+                        # past the prober's timeout would make a
+                        # merely BUSY node look dead and trigger
+                        # repair churn.
+                        async with gate.slot():
+                            resp, rbody = await self._dispatch(header,
+                                                               body)
+                    else:
                         resp, rbody = await self._dispatch(header, body)
-                else:
-                    resp, rbody = await self._dispatch(header, body)
-            # not silent: the error is returned to the peer in the reply
-            # and recorded on the server span (sp.err)
-            except Exception as e:  # noqa: BLE001  # dfslint: ignore[DFS007]
-                sp.err = type(e).__name__
-                resp, rbody = {"ok": False, "error": str(e)}, b""
-            # reply encoded inside the span so sp.bytes carries the real
-            # frame total; the buffers themselves are NOT joined — they
-            # go to the transport one by one below
-            head, bufs, nbytes_out = encode_frame(resp, rbody)
-            sp.bytes = nbytes_in + nbytes_out
+                # not silent: the error is returned to the peer in the
+                # reply and recorded on the server span (sp.err)
+                except Exception as e:  # noqa: BLE001  # dfslint: ignore[DFS007]
+                    sp.err = type(e).__name__
+                    resp, rbody = {"ok": False, "error": str(e)}, b""
+                # reply encoded inside the span so sp.bytes carries the
+                # real frame total; the buffers themselves are NOT
+                # joined — they go to the transport one by one below
+                head, bufs, nbytes_out = encode_frame(resp, rbody)
+                sp.bytes = nbytes_in + nbytes_out
+        finally:
+            if dl_token is not None:
+                deadline.restore(dl_token)
         self.obs.rpc_server.record(
             tr[2] if tr is not None and tr[2] is not None else "-",
             str(op), time.perf_counter() - t0,
@@ -848,6 +879,16 @@ class StorageNodeServer:
 
     async def _dispatch(self, header: dict, body) -> tuple[dict, object]:
         op = header.get("op")
+        if deadline.expired():
+            # the caller's end-to-end budget ran out while this frame
+            # sat in the admission queue (or in flight): dropping HERE
+            # — before any CAS-pool job, hash pass, or payload write —
+            # is the whole point of carrying deadlines on the wire.
+            # Expired work must never reach a worker thread.
+            self.counters.inc("deadline_drops")
+            self.obs.event("deadline_shed", where="dispatch",
+                           op=str(op))
+            return {"ok": False, "error": "deadline expired"}, b""
         repoch = header.get("repoch")
         rfp = header.get("rfp")
         if isinstance(repoch, int) and not isinstance(repoch, bool) \
@@ -1828,6 +1869,13 @@ class StorageNodeServer:
                         on_slice=on_slice)
                     self.ingest_stalls.peak("sliceInflight", peak)
                 self.health.mark_alive(node_id)
+            except DeadlineExpired:
+                # the caller's budget died, not the peer: abort the
+                # upload as a 503-class refusal (see _place_batch's
+                # gather) — swallowing it here would count every peer
+                # as a replication failure and end in a quorum-fail 500
+                # on a healthy cluster
+                raise
             except RpcError as e:
                 self.log.warning("replication to node %d failed: %s",
                                  node_id, e)
@@ -1993,6 +2041,9 @@ class StorageNodeServer:
             if b is None:
                 try:
                     b = await self._fetch_chunk(d, ln)
+                except DeadlineExceeded:
+                    raise          # budget died: 503-class, never a
+                    # "held nowhere reachable" 500
                 except DownloadError:
                     raise UploadError(
                         f"filter-credited chunk {d[:12]}… held nowhere "
@@ -2066,11 +2117,23 @@ class StorageNodeServer:
             (t for t in self.cfg.cluster.sorted_ids()
              if t != self.cfg.node_id and t not in candidates),
             key=lambda t: not self.health.is_alive(t))
+        if self.serve.hedge is not None:
+            # hedged reads (docs/serve.md): same candidate walk, but a
+            # primary that outlives its latency-derived hedge delay
+            # races the NEXT replica — first verified answer wins
+            return await self._fetch_chunk_hedged(digest, length,
+                                                  candidates)
         for target in candidates:
             try:
                 data = await self.client.get_chunk(
                     self.cfg.cluster.peer(target), digest)
                 self.health.mark_alive(target)
+            except DeadlineExpired as e:
+                # the budget died, not the replicas: stop the walk —
+                # touring the remaining candidates would count each
+                # refusal as a remote miss (placement-skew evidence)
+                # and waste exactly the work the deadline forbids
+                raise DeadlineExceeded(str(e)) from e
             except RpcUnreachable:
                 self.health.mark_dead(target)
                 continue
@@ -2092,6 +2155,254 @@ class StorageNodeServer:
             self.log.warning("corrupt chunk %s from node %d",
                              digest[:12], target)
         raise DownloadError(f"Could not retrieve chunk {digest[:12]}…")
+
+    async def _fetch_chunk_hedged(self, digest: str, length: int,
+                                  candidates: list[int]) -> bytes:
+        """The hedged-read walk of :meth:`_fetch_chunk` ("The Tail at
+        Scale"): a primary replica that has not answered within
+        ``HedgePolicy.delay_s`` of ITS OWN windowed mean latency races
+        the next replica in the (dual-read/ring-aware) candidate order;
+        the first VERIFIED answer wins, the loser is cancelled, and
+        every hedge draws from the node's token bucket so hedging can
+        never double cluster fetch load. The per-replica outcome
+        handling (health marks, miss counters, digest verification) is
+        the serial walk's, verbatim — a hedge changes WHEN the next
+        replica is asked, never what counts as an answer. Coalesced
+        readers (serve/rpc single-flight) share the leader's hedge
+        decision by construction: the hedge fires inside the one flight
+        they all await."""
+        hedge = self.serve.hedge
+        rf = self.cfg.cluster.replication_factor
+
+        async def attempt(nid: int) -> bytes | None:
+            """One replica's verified bytes, or None — miss, corrupt,
+            or dead, with exactly the serial walk's bookkeeping."""
+            try:
+                data = await self.client.get_chunk(
+                    self.cfg.cluster.peer(nid), digest)
+                self.health.mark_alive(nid)
+            except DeadlineExpired as e:
+                raise DeadlineExceeded(str(e)) from e  # stop the walk
+            except RpcUnreachable:
+                self.health.mark_dead(nid)
+                return None
+            except RpcError:
+                # live peer without the chunk — not a death signal (see
+                # _fetch_chunk; counted for placement-skew visibility)
+                self.counters.inc("remote_chunk_misses")
+                return None
+            if len(data) == length and sha256_hex(data) == digest:
+                return data
+            self.log.warning("corrupt chunk %s from node %d",
+                             digest[:12], nid)
+            return None
+
+        def accept(data: bytes, src: int) -> bytes:
+            self.counters.inc("chunks_fetched_remote")
+            if self.ring.is_prev_only(digest, src, rf):
+                self.ring.note_dual_read_hit()
+            return data
+
+        i = 0
+        while i < len(candidates):
+            nid = candidates[i]
+            backup_id = candidates[i + 1] if i + 1 < len(candidates) \
+                else None
+            if backup_id is None:
+                data = await attempt(nid)
+                if data is not None:
+                    return accept(data, nid)
+                i += 1
+                continue
+            task = asyncio.create_task(attempt(nid))
+            btask: asyncio.Task | None = None
+            try:
+                # delay seeded by the BEST replica's windowed mean, not
+                # the primary's own (RpcStats.recent_best_mean: a slow
+                # primary's samples would talk its own hedge out of
+                # firing)
+                delay = hedge.delay_s(
+                    self.obs.rpc_client.recent_best_mean("get_chunk"))
+                try:
+                    data = await asyncio.wait_for(asyncio.shield(task),
+                                                  delay)
+                # absence-as-result: the timeout IS the hedge trigger —
+                # the shielded primary keeps running, awaited below
+                except asyncio.TimeoutError:  # dfslint: ignore[DFS007]
+                    data = None
+                if task.done():
+                    # the primary answered (or failed fast) within the
+                    # delay: no hedge — exactly the serial walk's step
+                    if data is None:
+                        data = task.result()
+                    if data is not None:
+                        return accept(data, nid)
+                    i += 1
+                    continue
+                if not hedge.take():
+                    # budget empty: wait the primary out (hedging must
+                    # never become its own overload — the denial is
+                    # counted and windowed for the doctor's
+                    # hedge_storm)
+                    data = await task
+                    if data is not None:
+                        return accept(data, nid)
+                    i += 1
+                    continue
+                hedge.note_fired()
+                self.obs.event("hedge_fired", digest=digest[:12],
+                               primary=nid, backup=backup_id,
+                               delayS=round(delay, 4))
+                btask = asyncio.create_task(attempt(backup_id))
+                done, _ = await asyncio.wait(
+                    {task, btask}, return_when=asyncio.FIRST_COMPLETED)
+                first, other = (task, btask) if task in done \
+                    else (btask, task)
+                first_id, other_id = (nid, backup_id) if first is task \
+                    else (backup_id, nid)
+                data = first.result()      # attempt() raises only
+                # DeadlineExceeded (reaped by the handler below)
+                src = first_id
+                if data is None:
+                    # first finisher missed/failed: the race collapses
+                    # to waiting on the other — no third fetch issued
+                    data = await other
+                    src = other_id
+                else:
+                    other.cancel()         # loser cancelled
+                    with contextlib.suppress(asyncio.CancelledError):
+                        await other
+            except (asyncio.CancelledError, DeadlineExceeded):
+                # OUR caller was cancelled (client hung up mid-read) or
+                # the deadline died mid-race: the racers must die with
+                # it — shield/asyncio.wait leave their tasks running
+                # detached otherwise, still transferring bytes for a
+                # reader that is gone
+                task.cancel()
+                if btask is not None:
+                    btask.cancel()
+                await asyncio.gather(task,
+                                     *([btask] if btask is not None
+                                       else []),
+                                     return_exceptions=True)
+                raise
+            if data is not None:
+                if src == backup_id:
+                    hedge.note_won()
+                    self.obs.event("hedge_won", digest=digest[:12],
+                                   primary=nid, backup=backup_id)
+                return accept(data, src)
+            i += 2                         # both replicas consumed
+        raise DownloadError(f"Could not retrieve chunk {digest[:12]}…")
+
+    async def _hedged_get_chunks(self, primary_id: int, backup_id: int,
+                                 digests: list[str], expect: int
+                                 ) -> tuple[list, int]:
+        """Hedged batched fetch (docs/serve.md): issue ``get_chunks``
+        to the primary; if it outlives its latency-derived hedge delay
+        and the token bucket allows, race the SAME batch against the
+        backup replica — first completed reply wins, loser cancelled.
+        Returns ``(pairs, winner_id)``; exceptions propagate only when
+        BOTH sides fail (attributed to the primary — the caller's
+        health/error handling stays aimed at the peer it chose), so a
+        hedge can only ever improve on the unhedged call."""
+        hedge = self.serve.hedge
+
+        async def issue(nid: int):
+            return await self.client.get_chunks(
+                self.cfg.cluster.peer(nid), digests,
+                retries=None if self.health.is_alive(nid) else 1,
+                expect_bytes=expect)
+
+        task = asyncio.create_task(issue(primary_id))
+        btask: asyncio.Task | None = None
+
+        async def reap_on_cancel() -> None:
+            """OUR caller was cancelled: the racers must die with it —
+            shield/asyncio.wait leave their tasks running detached
+            otherwise (up to two ~32 MiB transfers for a reader that
+            is gone), and an unretrieved RpcError would log 'exception
+            was never retrieved' at GC."""
+            task.cancel()
+            if btask is not None:
+                btask.cancel()
+            await asyncio.gather(task,
+                                 *([btask] if btask is not None
+                                   else []),
+                                 return_exceptions=True)
+
+        # best-replica seed, not the primary's own mean — see
+        # RpcStats.recent_best_mean for the observed failure mode
+        delay = hedge.delay_s(
+            self.obs.rpc_client.recent_best_mean("get_chunks"))
+        try:
+            return await asyncio.wait_for(asyncio.shield(task),
+                                          delay), primary_id
+        # absence-as-result: the timeout IS the hedge trigger — the
+        # shielded primary keeps running and is raced below
+        except asyncio.TimeoutError:  # dfslint: ignore[DFS007]
+            pass                        # primary still in flight: hedge
+        except asyncio.CancelledError:
+            await reap_on_cancel()
+            raise
+        except BaseException:
+            raise                       # primary failed fast — the
+            # caller's RpcUnreachable/RpcError handling applies as-is
+        if not hedge.take():
+            try:
+                return await task, primary_id
+            except asyncio.CancelledError:
+                await reap_on_cancel()   # awaiting a Task does not
+                raise                    # cancel it — reap explicitly
+        hedge.note_fired()
+        self.obs.event("hedge_fired", op="get_chunks",
+                       primary=primary_id, backup=backup_id,
+                       chunks=len(digests), delayS=round(delay, 4))
+        btask = asyncio.create_task(issue(backup_id))
+        try:
+            done, _ = await asyncio.wait(
+                {task, btask}, return_when=asyncio.FIRST_COMPLETED)
+        except asyncio.CancelledError:
+            await reap_on_cancel()
+            raise
+        first, other = (task, btask) if task in done else (btask, task)
+        first_id, other_id = (primary_id, backup_id) if first is task \
+            else (backup_id, primary_id)
+        ferr = first.exception()
+        if ferr is None:
+            # loser cancelled; if it had already failed unreachable,
+            # keep the evidence (the health registry would learn it
+            # from the next probe anyway — this is just sooner)
+            other.cancel()
+            try:
+                await other
+            except (asyncio.CancelledError, RpcError, WireError):  # dfslint: ignore[DFS007]
+                pass    # reaped: the winner's reply is the result
+            if not other.cancelled() \
+                    and isinstance(other.exception(), RpcUnreachable):
+                self.health.mark_dead(other_id)
+            if first_id == backup_id:
+                hedge.note_won()
+                self.obs.event("hedge_won", op="get_chunks",
+                               primary=primary_id, backup=backup_id)
+            return first.result(), first_id
+        # first finisher failed: fall to the other side — no third RPC
+        if isinstance(ferr, RpcUnreachable):
+            self.health.mark_dead(first_id)
+        try:
+            got = await other
+        except asyncio.CancelledError:
+            await reap_on_cancel()       # the racer must die with us
+            raise
+        except (RpcError, WireError) as e:
+            # both failed: surface the PRIMARY's failure class so the
+            # caller's diagnosis targets the peer it actually chose
+            raise (ferr if first_id == primary_id else e) from None
+        if other_id == backup_id:
+            hedge.note_won()
+            self.obs.event("hedge_won", op="get_chunks",
+                           primary=primary_id, backup=backup_id)
+        return got, other_id
 
     _FETCH_BATCH_BYTES = 32 * 1024 * 1024
 
@@ -2180,17 +2491,44 @@ class StorageNodeServer:
                 nonlocal batch, size
                 if not batch:
                     return
+                # hedge target for this batch (docs/serve.md): the most
+                # common next-replica among the batch's digests — for
+                # the dominant case (one slow primary, ring-adjacent
+                # replica sets) every digest agrees; digests the backup
+                # happens to lack stay missing and the mop-up rounds
+                # fetch them, exactly as for any partial reply
+                backup_id = None
+                if self.serve.hedge is not None:
+                    votes: dict[int, int] = {}
+                    for d in batch:
+                        for t in candidates_for(d):
+                            if t != node_id and t != self.cfg.node_id:
+                                votes[t] = votes.get(t, 0) + 1
+                                break
+                    if votes:
+                        backup_id = max(votes, key=votes.get)
+                src = node_id
                 try:
                     # known-dead peers get one fast probe, not the full
                     # retry envelope (same rule replication uses) — a
                     # degraded EC read would otherwise pay retries per
                     # batch for holders that died
-                    got = await self.client.get_chunks(
-                        peer, batch,
-                        retries=None if self.health.is_alive(node_id)
-                        else 1,
-                        expect_bytes=sum(need[d] for d in batch))
-                    self.health.mark_alive(node_id)
+                    if backup_id is not None:
+                        got, src = await self._hedged_get_chunks(
+                            node_id, backup_id, list(batch),
+                            sum(need[d] for d in batch))
+                    else:
+                        got = await self.client.get_chunks(
+                            peer, batch,
+                            retries=None
+                            if self.health.is_alive(node_id) else 1,
+                            expect_bytes=sum(need[d] for d in batch))
+                    self.health.mark_alive(src)
+                except DeadlineExpired as e:
+                    # the budget died, not the peer: abort the gather
+                    # (503-class) instead of regrouping onto the next
+                    # replica and polluting the miss/error counters
+                    raise DeadlineExceeded(str(e)) from e
                 except RpcUnreachable:
                     self.health.mark_dead(node_id)
                     got = []
@@ -2218,7 +2556,7 @@ class StorageNodeServer:
                             out[d] = b
                             self.counters.inc("chunks_fetched_remote")
                             if ring.migrating and ring.is_prev_only(
-                                    d, node_id, rf):
+                                    d, src, rf):
                                 ring.note_dual_read_hit()
                 batch, size = [], 0
 
@@ -2283,6 +2621,8 @@ class StorageNodeServer:
                         retries=1)
                     for d in resp.get("have", []):
                         claims.setdefault(d, nid)
+                except DeadlineExpired as e:
+                    raise DeadlineExceeded(str(e)) from e
                 except RpcError:
                     # best-effort sweep; counted (DFS007) — habitual
                     # probe failures silently shrink the replica set a
@@ -2314,6 +2654,9 @@ class StorageNodeServer:
                 async with sem:
                     try:
                         out[d] = await self._fetch_chunk(d, need[d])
+                    except DeadlineExceeded:
+                        raise          # dead budget ends the read —
+                        # never "chunk missing"
                     # not silent: the digest stays missing and the strict
                     # raise / best-effort skip below carries the failure
                     except DownloadError:  # dfslint: ignore[DFS007]
@@ -2574,6 +2917,15 @@ class StorageNodeServer:
         and resolve the waiters. A leader failure rejects its claims
         (waiters of THIS flight see it; the next request re-leads — no
         poisoning). Default config: exactly the direct path."""
+        if deadline.expired():
+            # already-dead read: refuse BEFORE the cache scan, flight
+            # claims, and above all the CAS pool — a request whose
+            # caller gave up must not occupy a disk worker (checked per
+            # batch, so a mid-download expiry stops the remaining
+            # batches too). No deadline set = one ContextVar read.
+            self.counters.inc("deadline_drops")
+            self.obs.event("deadline_shed", where="fetch")
+            raise DeadlineExceeded("deadline expired")
         serve = self.serve
         if not serve.read_path_enabled:
             return await self._fetch_verified_direct(manifest, chunks,
@@ -2893,6 +3245,10 @@ class StorageNodeServer:
             "peersAlive": self.health.snapshot(),
             "underReplicated": len(self.under_replicated),
             "admission": self.serve.admission.stats(),
+            # hedged-read counters incl. the 60 s fired/denied windows —
+            # the doctor's hedge_storm evidence (docs/serve.md)
+            "hedge": self.serve.hedge.stats()
+            if self.serve.hedge is not None else {"enabled": False},
             "cache": self.serve.cache.stats()
             if self.serve.cache is not None else {"enabled": False},
             "ingestStalls": self.ingest_stalls.snapshot(),

@@ -145,10 +145,14 @@ class RpcStats:
         self._lock = threading.Lock()
         # (peer, op) -> [count, errors, retries, bytes_out, bytes_in, s]
         self._m: dict[tuple, list] = {}
-        # (peer, op) -> [deque[(monotonic ts, seconds)], rolling sum,
-        # rolling count] for the window — sums maintained on append and
-        # expiry so snapshot() never scans a deque under the lock the
-        # data plane's record() takes
+        # (peer, op) -> [deque[(monotonic ts, seconds, error)],
+        # rolling sum, rolling count, ok-only sum, ok-only count] for
+        # the window — sums maintained on append and expiry so
+        # snapshot() never scans a deque under the lock the data
+        # plane's record() takes. The all-samples pair feeds the
+        # doctor's slow_peer rule (timeouts make a peer slow ON
+        # PURPOSE); the ok-only pair feeds the hedge delay (a fast
+        # error reply is not "what a healthy fetch takes").
         self._recent: dict[tuple, list] = {}
         self._overflow_warned = False
 
@@ -173,10 +177,13 @@ class RpcStats:
             row[5] += seconds
             ent = self._recent.get(key)
             if ent is None:
-                ent = self._recent[key] = [deque(), 0.0, 0]
-            ent[0].append((now, seconds))
+                ent = self._recent[key] = [deque(), 0.0, 0, 0.0, 0]
+            ent[0].append((now, seconds, error))
             ent[1] += seconds
             ent[2] += 1
+            if not error:
+                ent[3] += seconds
+                ent[4] += 1
             self._expire(ent, now)
 
     def _expire(self, ent: list, now: float) -> None:
@@ -185,16 +192,49 @@ class RpcStats:
         dq = ent[0]
         cutoff = now - self.RECENT_WINDOW_S
         while dq and (dq[0][0] < cutoff or len(dq) > self._RECENT_MAX):
-            _, s = dq.popleft()
+            _, s, err = dq.popleft()
             ent[1] -= s
             ent[2] -= 1
+            if not err:
+                ent[3] -= s
+                ent[4] -= 1
         if ent[2] == 0:
             ent[1] = 0.0   # re-zero float drift at every empty window
+        if ent[4] == 0:
+            ent[3] = 0.0
 
     def retry(self, peer, op: str) -> None:
         with self._lock:
             _, row = self._row(peer, op)
             row[2] += 1
+
+    def recent_best_mean(self, op: str) -> float | None:
+        """The LOWEST per-peer windowed mean of SUCCESSFUL calls for
+        ``op`` — "what a healthy replica currently takes". Successful
+        only: a live peer answering fast *errors* (a 1 ms chunk-miss
+        reply during placement skew) would otherwise collapse the best
+        mean — and with it the hedge delay — to the floor, tripping a
+        hedge on nearly every remote fetch. And the BEST replica's
+        mean, not the primary's own: seeding from the primary is
+        self-referential — its slow samples would push its own hedge
+        delay past its slowness and disable hedging exactly when it is
+        needed (observed live in r18 bring-up: three reads against a
+        250 ms-slow replica walked the delay 59→177→300 ms and the
+        third read never hedged). O(peers) under the lock, called once
+        per remote fetch."""
+        now = time.monotonic()
+        best: float | None = None
+        with self._lock:
+            for (p, o), ent in self._recent.items():
+                if o != op:
+                    continue
+                self._expire(ent, now)
+                if ent[4] == 0:
+                    continue
+                mean = ent[3] / ent[4]
+                if best is None or mean < best:
+                    best = mean
+        return best
 
     def snapshot(self) -> dict:
         """JSON /metrics shape: '<peer>:<op>' -> counters dict.

@@ -79,6 +79,17 @@ Rules (each produces ``{"rule", "severity", "peers", "evidence"}``):
                        membership summary that stopped refreshing —
                        the gossip loop is failing (see its
                        ``filter_sync_failures`` counter / journal).
+- ``hedge_storm``    — a node's hedged reads fired at (or beyond) the
+                       hedge budget's refill rate for a sustained
+                       window (r18: ``firedRecent``/``deniedRecent``,
+                       the serve ``hedge`` stats' 60 s deques — the
+                       shed_storm no-latch discipline): some replica
+                       set is slow enough that nearly EVERY read wants
+                       a hedge, i.e. the hedge plane is masking a sick
+                       replica at steady cost instead of absorbing a
+                       transient blip — find the slow peer (the
+                       slow_peer rule usually names it) rather than
+                       raising the budget.
 
 Thresholds live here as module constants, documented in
 docs/observability.md; the bench's injected-slow-peer scenario
@@ -102,6 +113,10 @@ REBALANCE_STUCK_S = 120.0  # migrating with no progress this long =
 INDEX_STALE_FACTOR = 10.0  # x the node's configured filter_sync_s
 INDEX_STALE_MIN_S = 60.0   # absolute floor, so a sub-second sync
                         # cadence does not page on one missed round
+HEDGE_STORM_MIN_FIRED = 8  # windowed-fired floor: a handful of hedges
+                        # in a minute is the plane working, not a storm
+HEDGE_STORM_WINDOW_S = 60.0  # the serve hedge stats' recency window
+                        # (HedgePolicy.RECENT_WINDOW_S)
 CENSUS_STALE_S = 900.0  # census findings older than this stop firing
                         # the underreplication rule: the census is
                         # pull-only, so a days-old snapshot must not
@@ -440,10 +455,56 @@ def diagnose(snapshots: dict[int, dict | None],
                                 "probe-skipping placement is trusting "
                                 "a summary that stopped refreshing"})
 
+    def hedge_storm() -> None:
+        # sustained hedging at the budget's refill rate: fired count
+        # over the window reaches what the refill could possibly grant
+        # — or hedges are being DENIED repeatedly (demand past the
+        # budget). Either way the hedge plane is doing steady work,
+        # which means a replica is persistently slow, not transiently
+        # blipping. Both clauses carry the MIN floor: one blip that
+        # wanted burst+1 hedges yields a single denial, and that is
+        # the plane absorbing it as designed, not a storm.
+        for nid, snap in sorted(live.items()):
+            h = snap.get("hedge") or {}
+            if not h.get("enabled"):
+                continue
+            refill = h.get("budgetPerS")
+            fired = h.get("firedRecent", 0)
+            denied = h.get("deniedRecent", 0)
+            if not isinstance(refill, (int, float)) or refill <= 0 \
+                    or not isinstance(fired, int) \
+                    or not isinstance(denied, int):
+                continue
+            # the at-refill-rate bar, clamped to what the producer's
+            # bounded window can actually count (hedge.py windowCap —
+            # a saturated window IS a storm): without the clamp the
+            # bar is unreachable for budgets above windowCap/60 per
+            # second and the rule is dead code exactly for generous
+            # budgets. Absent cap (old build) = unclamped fallback.
+            bar = refill * HEDGE_STORM_WINDOW_S
+            cap = h.get("windowCap")
+            if isinstance(cap, int) and cap > 0:
+                bar = min(bar, cap)
+            if denied >= HEDGE_STORM_MIN_FIRED \
+                    or (fired >= HEDGE_STORM_MIN_FIRED
+                        and fired >= bar):
+                findings.append({
+                    "rule": "hedge_storm", "severity": "warning",
+                    "peers": [nid],
+                    "evidence": f"{fired} hedged read(s) fired"
+                                + (f" and {denied} denied" if denied
+                                   else "")
+                                + f" in the last "
+                                  f"{HEDGE_STORM_WINDOW_S:.0f}s against "
+                                  f"a {refill:g}/s hedge budget — a "
+                                  "replica is persistently slow (see "
+                                  "slow_peer), the hedge plane is "
+                                  "masking it at steady cost"})
+
     for rule in (dead_peer, slow_peer, shed_storm, credit_starvation,
                  cache_thrash, clock_skew, config_drift, loop_lag,
                  capacity_trend, underreplication, epoch_mismatch,
-                 rebalance_stuck, index_stale):
+                 rebalance_stuck, index_stale, hedge_storm):
         try:
             rule()
         except Exception as e:   # noqa: BLE001 — see docstring

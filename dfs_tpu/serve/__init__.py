@@ -29,17 +29,20 @@ pre-serving-tier code path — tier-1 tests enforce that.
 
 from __future__ import annotations
 
-from dfs_tpu.serve.admission import AdmissionControl, ShedError
+from dfs_tpu.serve.admission import (AdmissionControl, ClientDisconnected,
+                                     ShedError)
 from dfs_tpu.serve.cache import ChunkCache
+from dfs_tpu.serve.hedge import HedgePolicy
 from dfs_tpu.serve.prefetch import BatchPrefetcher
 from dfs_tpu.serve.singleflight import SingleFlight
 
 
 class ServingTier:
     """One node's serving-tier state: the hot-chunk cache (None when the
-    budget is 0), the per-digest single-flight table, and the admission
-    gates. Constructed unconditionally by the node runtime — the
-    default-off config makes every component a no-op."""
+    budget is 0), the per-digest single-flight table, the admission
+    gates, and the hedged-read policy (None when the hedge budget is 0).
+    Constructed unconditionally by the node runtime — the default-off
+    config makes every component a no-op."""
 
     def __init__(self, cfg, obs=None) -> None:
         self.cfg = cfg
@@ -50,6 +53,12 @@ class ServingTier:
         # cache/flight are traced at their call sites in the runtime
         self.admission = AdmissionControl(cfg, obs=obs)
         self.readahead_batches = int(cfg.readahead_batches)
+        # hedged reads (serve/hedge.py): the budget refill IS the master
+        # switch — 0 builds no policy and _fetch_chunk / the batched
+        # gather run the historical single-replica walk exactly
+        self.hedge = HedgePolicy(cfg.hedge_floor_s, cfg.hedge_cap_s,
+                                 cfg.hedge_budget_per_s) \
+            if cfg.hedge_budget_per_s > 0 else None
 
     @property
     def read_path_enabled(self) -> bool:
@@ -77,6 +86,16 @@ class ServingTier:
             "flight": self.flight.stats(),
             "admission": self.admission.stats(),
             "readaheadBatches": self.readahead_batches,
+            # end-to-end deadline default (docs/serve.md §deadlines) —
+            # the per-request countdown itself lives in the contextvar
+            "defaultDeadlineS": self.cfg.default_deadline_s,
+            # hedged-read knobs + live counters; the off shape mirrors
+            # cache's {"enabled": False}
+            "hedge": self.hedge.stats() if self.hedge is not None
+            else {"enabled": False,
+                  "floorS": self.cfg.hedge_floor_s,
+                  "capS": self.cfg.hedge_cap_s,
+                  "budgetPerS": self.cfg.hedge_budget_per_s},
         }
         if self.cache is not None:
             out["cache"] = self.cache.stats()
@@ -88,4 +107,5 @@ class ServingTier:
 
 
 __all__ = ["AdmissionControl", "BatchPrefetcher", "ChunkCache",
-           "ServingTier", "ShedError", "SingleFlight"]
+           "ClientDisconnected", "HedgePolicy", "ServingTier",
+           "ShedError", "SingleFlight"]

@@ -10,6 +10,16 @@ beyond it — a request that cannot be queued gets an immediate
 client one cheap retry instead of costing every in-flight request its
 latency budget.
 
+Since r18 the gate is also DEADLINE-AWARE (docs/serve.md §deadlines):
+a request that arrives with its end-to-end deadline already expired is
+shed immediately, and a QUEUED waiter whose deadline passes is evicted
+from the queue — both counted ``deadlineShed`` (separately from
+capacity ``shed``: the SEDA lesson is that burning a worker slot on a
+request whose caller already gave up is the purest form of overload
+waste). And a queued waiter may carry a ``disconnected`` watcher: a
+client that hangs up while queued frees its queue position instead of
+consuming a slot when it reaches the head (:class:`ClientDisconnected`).
+
 ``slots <= 0`` disables a gate entirely (the default config): acquire
 returns synchronously, no counters move, tier-1 semantics unchanged.
 """
@@ -22,16 +32,24 @@ import time
 
 import asyncio
 
+from dfs_tpu.utils import deadline
+
 
 class ShedError(RuntimeError):
     """Request refused by admission control — maps to HTTP 503 with a
     Retry-After header at the API layer."""
 
-    def __init__(self, cls: str, retry_after_s: float) -> None:
-        super().__init__(f"{cls} capacity exhausted, retry after "
+    def __init__(self, cls: str, retry_after_s: float,
+                 reason: str = "capacity exhausted") -> None:
+        super().__init__(f"{cls} {reason}, retry after "
                          f"{retry_after_s:g}s")
         self.request_class = cls
         self.retry_after_s = retry_after_s
+
+
+class ClientDisconnected(RuntimeError):
+    """A queued waiter's client hung up before its slot was granted —
+    there is nobody left to answer; the handler just tears down."""
 
 
 class AdmissionGate:
@@ -63,6 +81,12 @@ class AdmissionGate:
         self.admitted = 0
         self.queued = 0
         self.shed = 0
+        # deadline-expired requests shed at arrival or evicted from the
+        # queue — counted SEPARATELY from capacity sheds (the shed curve
+        # and the deadline plane are different diagnoses)
+        self.deadline_shed = 0
+        # queued waiters whose client hung up before the grant
+        self.disconnects = 0
         self._shed_ts: collections.deque[float] = \
             collections.deque(maxlen=self._SHED_TS_MAX)
 
@@ -70,9 +94,27 @@ class AdmissionGate:
     def enabled(self) -> bool:
         return self.slots > 0
 
-    async def acquire(self) -> None:
+    def _shed_deadline(self, where: str) -> None:
+        """Count + journal a deadline shed, then refuse. Never touches
+        ``shed``/``shedRecent`` — the doctor's shed_storm rule reads
+        those as the CAPACITY overload signal."""
+        self.deadline_shed += 1
+        if self._obs is not None:
+            self._obs.event("deadline_shed", cls=self.name, where=where)
+        raise ShedError(self.name, self.retry_after_s,
+                        reason=f"deadline expired ({where})")
+
+    async def acquire(self, disconnected=None) -> None:
+        """Take a slot (or queue for one). ``disconnected`` is an
+        optional zero-arg factory returning an awaitable that completes
+        when the caller's client hangs up (e.g. an EOF-returning socket
+        read); it is started only if this acquire actually queues."""
         if not self.enabled:
             return
+        if deadline.expired():
+            # dead on arrival: the caller already gave up — never take
+            # a slot, never join the queue
+            self._shed_deadline("arrival")
         if self._active < self.slots:
             self._active += 1
             self.admitted += 1
@@ -96,9 +138,9 @@ class AdmissionGate:
         try:
             if self._obs is not None:
                 with self._obs.span(f"admission.{self.name}.wait"):
-                    await fut
+                    await self._await_grant(fut, disconnected)
             else:
-                await fut
+                await self._await_grant(fut, disconnected)
         except asyncio.CancelledError:
             if fut.done() and not fut.cancelled():
                 # the grant raced our cancellation: the slot was already
@@ -106,6 +148,69 @@ class AdmissionGate:
                 self._release_slot()
             raise
         self.admitted += 1
+
+    def _abandon(self, fut: asyncio.Future) -> None:
+        """Leave the queue without taking the slot. If the grant raced
+        us the slot is already ours — pass it straight to the next
+        waiter; otherwise cancel our future so ``_release_slot`` skips
+        the ghost."""
+        if fut.done() and not fut.cancelled():
+            self._release_slot()
+        else:
+            fut.cancel()
+
+    async def _await_grant(self, fut: asyncio.Future,
+                           disconnected) -> None:
+        """Wait for the queue grant, bounded by the caller's deadline
+        and aborted by client disconnect (``disconnected`` is the
+        zero-arg watcher factory — the watch is created, re-armed, and
+        cancelled HERE). Plain ``await fut`` when neither applies —
+        the historical queued path exactly."""
+        watch: asyncio.Future | None = \
+            asyncio.ensure_future(disconnected()) \
+            if disconnected is not None else None
+        try:
+            while True:
+                rem = deadline.remaining()
+                if rem is None and watch is None:
+                    await fut
+                    return
+                aws = {fut} if watch is None else {fut, watch}
+                done, _ = await asyncio.wait(
+                    aws,
+                    timeout=max(0.0, rem) if rem is not None else None,
+                    return_when=asyncio.FIRST_COMPLETED)
+                if fut in done:
+                    return                  # granted (watch cancelled
+                    # by the finally; a raced disconnect surfaces at
+                    # the response write, exactly like the fast path)
+                if watch is not None and watch in done:
+                    failed = watch.cancelled() or \
+                        watch.exception() is not None
+                    if failed or not watch.result():
+                        # EOF / reset while queued: the client is gone
+                        # — free the queue position NOW so the slot,
+                        # when it reaches this position, passes to a
+                        # live waiter
+                        self._abandon(fut)
+                        self.disconnects += 1
+                        if self._obs is not None:
+                            self._obs.event("queue_disconnect",
+                                            cls=self.name)
+                        raise ClientDisconnected(
+                            f"{self.name} client hung up while queued")
+                    # stray byte from a pipelining client: not a
+                    # hangup — RE-ARM (one-shot disarming left the
+                    # later real EOF unobserved, and the dead request
+                    # consumed a slot at the head after all)
+                    watch = asyncio.ensure_future(disconnected())
+                    continue
+                # asyncio.wait timed out: deadline passed while queued
+                self._abandon(fut)
+                self._shed_deadline("queue")
+        finally:
+            if watch is not None:
+                watch.cancel()
 
     def release(self) -> None:
         if not self.enabled:
@@ -135,6 +240,8 @@ class AdmissionGate:
                 "waiting": sum(1 for f in self._queue if not f.done()),
                 "admitted": self.admitted, "queuedTotal": self.queued,
                 "shed": self.shed,
+                "deadlineShed": self.deadline_shed,
+                "disconnects": self.disconnects,
                 "shedRecent": sum(1 for t in self._shed_ts if t >= cutoff)}
 
 

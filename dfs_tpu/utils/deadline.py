@@ -1,0 +1,110 @@
+"""End-to-end request deadlines (docs/serve.md §deadlines).
+
+A deadline is born once at the HTTP edge — from the client's
+``X-Dfs-Deadline: <seconds>`` header or ``ServeConfig.default_deadline_s``
+— and rides a :mod:`contextvars` variable exactly like the r09 trace
+context: every downstream hop of the request (placement tasks, the async
+CAS pool, admission queue waits, RPC calls) inherits it without
+plumbing, because ``asyncio.create_task`` / ``asyncio.to_thread`` copy
+the context.
+
+Representation: the context holds the ABSOLUTE ``time.monotonic()``
+expiry. Crossing a process boundary it is re-encoded as the REMAINING
+budget in seconds (the optional ``deadline`` wire-header field,
+comm/wire.py) — absolute wall times would import the sender's clock
+skew into the receiver's countdown; remaining-time hops lose only the
+network flight time, which is exactly the decrement the hop cost.
+
+Contract (the overload-survival plane, ROADMAP item 4): expired work
+must never reach a worker thread. The RPC client refuses to start or
+retry a call whose budget is gone; admission gates evict queued waiters
+whose deadline passed (counted ``deadlineShed``, never plain ``shed``);
+``_dispatch`` / ``_fetch_verified`` drop dead requests before touching
+the CAS pool. No deadline set (the default — header absent AND
+``default_deadline_s == 0``) means every check is one ContextVar read
+returning None: pre-r18 behavior byte-identical.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import math
+import time
+
+# absolute monotonic expiry of the current request, or None (no deadline
+# — the default, and pre-r18 behavior exactly)
+_ctx: contextvars.ContextVar[float | None] = \
+    contextvars.ContextVar("dfs_deadline", default=None)
+
+# a deadline asked to cover more than this is clamped: the field is
+# operator/client input off the wire, and an absurd value (hours) would
+# effectively disable the plane while looking enabled
+MAX_DEADLINE_S = 3600.0
+
+
+def activate(remaining_s: float) -> contextvars.Token:
+    """Start a deadline ``remaining_s`` seconds from now for the current
+    context; returns the token for :func:`restore`. A non-positive
+    budget still activates (instantly expired) — the caller asked for
+    it, and the drop paths are exactly what must fire."""
+    remaining_s = min(float(remaining_s), MAX_DEADLINE_S)
+    return _ctx.set(time.monotonic() + remaining_s)
+
+
+def restore(token: contextvars.Token) -> None:
+    _ctx.reset(token)
+
+
+def clear() -> contextvars.Token:
+    """Detach the current context from any deadline — for BACKGROUND
+    work spawned from inside a request (``asyncio.create_task`` copies
+    the context): a rebalance kicked by a deadlined RPC, say, must not
+    inherit the request's dying budget. Returns the token in case the
+    caller wants to restore; a task-level clear can drop it (the task's
+    context dies with it)."""
+    return _ctx.set(None)
+
+
+def parse_header(value: str | None) -> float | None:
+    """``X-Dfs-Deadline`` header value -> remaining seconds, or None for
+    absent/malformed (never raises — a bad header must not fail the
+    request it rides on, the X-Dfs-Trace discipline)."""
+    if not value:
+        return None
+    try:
+        s = float(value.strip())
+    except ValueError:
+        return None
+    if not math.isfinite(s):
+        return None
+    return s
+
+
+def parse_wire(field) -> float | None:
+    """Wire-header ``deadline`` field -> remaining seconds, or None for
+    absent/malformed (pre-r18 peers simply never send the field)."""
+    if isinstance(field, bool) or not isinstance(field, (int, float)):
+        return None
+    if not math.isfinite(field):
+        return None
+    return float(field)
+
+
+def remaining() -> float | None:
+    """Seconds left on the active deadline (may be negative once
+    expired), or None when no deadline is set."""
+    exp = _ctx.get()
+    if exp is None:
+        return None
+    return exp - time.monotonic()
+
+
+def expired() -> bool:
+    """True iff a deadline is set AND has passed. The no-deadline
+    default answers False from one ContextVar read."""
+    exp = _ctx.get()
+    return exp is not None and time.monotonic() >= exp
+
+
+__all__ = ["MAX_DEADLINE_S", "activate", "expired", "parse_header",
+           "parse_wire", "remaining", "restore"]

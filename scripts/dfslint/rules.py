@@ -321,14 +321,20 @@ _INGEST_METRIC_KEYS = {"window": "window", "flush_bytes": "flushBytes",
                        "slice_inflight": "sliceInflight",
                        "cas_io_threads": "cas"}
 # the four admission knobs surface inside the "admission" section;
-# cache_bytes inside "cache" (serve/__init__.py ServingTier.stats())
+# cache_bytes inside "cache"; the r18 hedge knobs inside "hedge"
+# (serve/__init__.py ServingTier.stats() — the journal/sentinel
+# nesting convention)
 _SERVE_METRIC_KEYS = {"cache_bytes": "cache",
                       "readahead_batches": "readaheadBatches",
                       "download_slots": "admission",
                       "upload_slots": "admission",
                       "internal_slots": "admission",
                       "queue_depth": "admission",
-                      "retry_after_s": "admission"}
+                      "retry_after_s": "admission",
+                      "default_deadline_s": "defaultDeadlineS",
+                      "hedge_floor_s": "hedge",
+                      "hedge_cap_s": "hedge",
+                      "hedge_budget_per_s": "hedge"}
 # observability knobs surface under /metrics "obs"
 # (dfs_tpu/obs/__init__.py Observability.stats()). The journal and
 # sentinel fields ride their nested sub-sections ("journal" carries
@@ -893,8 +899,11 @@ def check_buffer_lifetime(project: Project) -> Iterator[Finding]:
 # ------------------------------------------------------------------ #
 
 # header fields the transport layer itself owns (attached/consumed
-# outside any one op's client/handler pair)
-_WIRE_UNIVERSAL_REQ = frozenset({"op", "trace", "repoch", "rfp"})
+# outside any one op's client/handler pair). `deadline` (r18) is the
+# remaining end-to-end budget the RPC client stamps per attempt and the
+# frame server consumes before dispatch — envelope, like `trace`.
+_WIRE_UNIVERSAL_REQ = frozenset({"op", "trace", "repoch", "rfp",
+                                 "deadline"})
 _WIRE_UNIVERSAL_REPLY = frozenset({"ok", "error", "ringEpoch", "ring"})
 # client-side send seams: a dict literal carrying "op" passed to one of
 # these methods is a wire call site

@@ -551,6 +551,64 @@ def test_dfs005_index_fields_checked(tmp_path):
                            "dfs_tpu/node/runtime.py": runtime_ok}) == []
 
 
+def test_dfs005_deadline_hedge_fields_checked(tmp_path):
+    """r18: the ServeConfig deadline/hedge fields ride the same three
+    DFS005 edges — a deadline/hedge knob dropped from cmd_serve's
+    ServeConfig(...) call, and one whose /metrics key vanishes from
+    ServingTier.stats(), must both be findings; the fully-wired
+    fixture must be clean."""
+    cfg = (
+        "import dataclasses\n"
+        "@dataclasses.dataclass(frozen=True)\n"
+        "class ServeConfig:\n"
+        "    default_deadline_s: float = 0.0\n"
+        "    hedge_budget_per_s: float = 0.0\n")
+    cli_missing = (
+        "from dfs_tpu.config import ServeConfig\n"
+        "def cmd_serve(args):\n"
+        "    return ServeConfig(default_deadline_s="
+        "args.default_deadline)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--default-deadline', type=float,\n"
+        "                     default=0.0)\n")
+    serve_ok = (
+        "class ServingTier:\n"
+        "    def stats(self):\n"
+        "        return {'defaultDeadlineS': 0.0,\n"
+        "                'hedge': {'enabled': False}}\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/cli/main.py": cli_missing,
+                            "dfs_tpu/serve/__init__.py": serve_ok})
+    assert rules_of(found) == ["DFS005"]
+    assert "ServeConfig.hedge_budget_per_s" in found[0].message
+
+    cli_ok = (
+        "from dfs_tpu.config import ServeConfig\n"
+        "def cmd_serve(args):\n"
+        "    return ServeConfig(default_deadline_s="
+        "args.default_deadline,\n"
+        "                       hedge_budget_per_s=args.hedge_budget)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--default-deadline', type=float,\n"
+        "                     default=0.0)\n"
+        "    sub.add_argument('--hedge-budget', type=float,\n"
+        "                     default=0.0)\n")
+    serve_missing_key = (
+        "class ServingTier:\n"
+        "    def stats(self):\n"
+        "        return {'defaultDeadlineS': 0.0}\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/cli/main.py": cli_ok,
+                            "dfs_tpu/serve/__init__.py":
+                            serve_missing_key})
+    assert rules_of(found) == ["DFS005"]
+    assert "hedge" in found[0].message
+
+    assert lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                           "dfs_tpu/cli/main.py": cli_ok,
+                           "dfs_tpu/serve/__init__.py": serve_ok}) == []
+
+
 def test_dfs005_unmapped_field_needs_table_entry(tmp_path):
     cfg = (
         "import dataclasses\n"

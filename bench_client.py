@@ -1,0 +1,306 @@
+"""Smart-client data-plane acceptance bench -> CLIENT_r19.json: edge
+CDC + dedup, direct-to-owner striped transfers, single-hop ingest
+(dfs_tpu/client, docs/client.md).
+
+Four gates, every one against a REAL multi-process cluster
+(scripts/chaos_harness.py — separate ``dfs-tpu serve`` processes with
+the index/filter plane armed):
+
+1. dedup_reupload — upload a corpus through the smart client, let the
+   peer-existence filters gossip, mutate 1% of the corpus (one
+   contiguous region — the incremental-save shape CDC exists for),
+   re-upload through a FRESH client (cold echo cache: filters + the
+   trust-verification round do all the work). Gate: payload bytes the
+   client sent <= 3% of the rf-replicated corpus.
+2. striped_speedup — the same per-RPC latency injected on EVERY node
+   (even-handed: both paths pay it per storage-plane call), then the
+   corpus is read back twice: via the legacy single-coordinator relay
+   and via the smart client's direct-to-owner striped reads. Gate:
+   striped wall-clock >= 2x faster.
+3. verified_stale_and_slow — one peer's filter replica corrupted at
+   the client (all ones: it claims EVERYTHING exists) and one replica
+   made 250 ms slow, client-side hedging armed. Fresh corpus up +
+   down. Gate: the upload acks on the smart path, every downloaded
+   chunk was digest-verified client-side, the stale filter was
+   actually exercised (observed false positives healed by real
+   sends), and bytes are identical end to end — from the smart path
+   AND from every node's legacy path.
+4. interop — the legacy client against the new servers, and the new
+   client pinned to the coordinator-only path, both byte-identical
+   (wire compatibility both directions).
+
+Usage: python bench_client.py [--tiny] [--out PATH]
+Writes CLIENT_r19.json (or --out) and prints it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from scripts.chaos_harness import ClusterHarness, _sha256_hex  # noqa: E402
+from dfs_tpu.cli.client import NodeClient                      # noqa: E402
+from dfs_tpu.client import SmartClient                         # noqa: E402
+from dfs_tpu.config import ClientConfig                        # noqa: E402
+
+ART = "CLIENT_r19.json"
+RF = 2
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _smart(h: ClusterHarness, node: int = 1, **kw) -> SmartClient:
+    kw.setdefault("fallback", False)
+    return SmartClient(host="127.0.0.1", port=h.http_port(node),
+                       cfg=ClientConfig(**kw))
+
+
+def _corpus(n_files: int, file_bytes: int, seed: int) -> list[bytes]:
+    rng = random.Random(seed)
+    return [rng.randbytes(file_bytes) for _ in range(n_files)]
+
+
+def _wait_filters_synced(h: ClusterHarness, timeout: float = 30.0) -> None:
+    """Block until every node's replica of every peer's filter has
+    caught up with that peer's CURRENT local (gen, version) — replica
+    presence alone is not enough: the gossip runs from boot, so stale
+    replicas predating the corpus upload would vote 'absent' and the
+    dedup gate would measure the sync race, not the protocol."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        stats = {i: h.metrics(i).get("index", {})
+                 for i in range(1, h.n + 1)}
+        want = {i: ((s.get("filter") or {}).get("generation"),
+                    (s.get("filter") or {}).get("version", 0))
+                for i, s in stats.items()}
+        ok = True
+        for i, s in stats.items():
+            peers = (s.get("peerFilters") or {}).get("peers", {})
+            for p in range(1, h.n + 1):
+                if p == i:
+                    continue
+                rep = peers.get(str(p))
+                if rep is None or rep.get("gen") != want[p][0] \
+                        or rep.get("version", -1) < want[p][1]:
+                    ok = False
+        if ok:
+            return
+        time.sleep(0.3)
+    raise AssertionError("peer filters never caught up with sources")
+
+
+# ------------------------------------------------------------------ #
+# gate 1: 1%-mutated re-upload transfers <= 3%
+# ------------------------------------------------------------------ #
+
+def gate_dedup_reupload(h: ClusterHarness, tiny: bool) -> dict:
+    n_files = 8
+    file_bytes = 256 * 1024 if tiny else 4 * 1024 * 1024
+    corpus = _corpus(n_files, file_bytes, seed=19)
+    total = n_files * file_bytes
+
+    c1 = _smart(h, 1)
+    for i, data in enumerate(corpus):
+        info = c1.upload(data, name=f"base{i}.bin")
+        assert info["dataPlane"] == "smart", info
+    _wait_filters_synced(h)
+
+    # ONE contiguous 1%-of-corpus region mutated (xor, so length and
+    # chunk boundaries outside it survive CDC resynchronization)
+    region = max(1, total // 100)
+    mut = bytearray(corpus[n_files // 2])
+    start = len(mut) // 3
+    for i in range(start, min(len(mut), start + region)):
+        mut[i] ^= 0xA5
+    corpus[n_files // 2] = bytes(mut)
+
+    c2 = _smart(h, 2)                    # fresh client: cold echo cache
+    for i, data in enumerate(corpus):
+        info = c2.upload(data, name=f"re{i}.bin")
+        assert info["dataPlane"] == "smart", info
+    sent = c2.counters["transferredBytes"]
+    budget = RF * total
+    ratio = sent / budget
+    # byte identity of the mutated file through the legacy path
+    legacy = NodeClient(host="127.0.0.1", port=h.http_port(3))
+    got = legacy.download(_sha256_hex(corpus[n_files // 2]))
+    ok = ratio <= 0.03 and got == corpus[n_files // 2]
+    return {"ok": ok, "corpusBytes": total, "rf": RF,
+            "mutatedBytes": region, "payloadSent": sent,
+            "sentRatio": round(ratio, 5), "budgetRatio": 0.03,
+            "verifyRpcs": c2.counters["verifyRpcs"],
+            "probeRpcs": c2.counters["probeRpcs"],
+            "dedupSkippedBytes": c2.counters["dedupSkippedBytes"]}
+
+
+# ------------------------------------------------------------------ #
+# gate 2: striped direct reads >= 2x the coordinator relay
+# ------------------------------------------------------------------ #
+
+def gate_striped_speedup(h: ClusterHarness, tiny: bool) -> dict:
+    n_files = 6
+    file_bytes = 384 * 1024 if tiny else 4 * 1024 * 1024
+    corpus = _corpus(n_files, file_bytes, seed=47)
+    c = _smart(h, 1)
+    fids = [c.upload(d, name=f"s{i}.bin")["fileId"]
+            for i, d in enumerate(corpus)]
+
+    # link-latency model, applied even-handedly: EVERY node pays the
+    # same delay on EVERY outbound storage-plane RPC (rpc_delay_s —
+    # no node is special-cased).  The coordinator relay therefore pays
+    # it on the peer fetches it must make to assemble a file, while the
+    # striped client reads each owner's local chunks directly and
+    # crosses zero node-to-node links — that avoided relay hop is
+    # precisely the protocol win this gate measures.  Client-edge
+    # latency is NOT modelled: both paths make their first hop from the
+    # same external process, so it would add the same constant to both.
+    for i in range(1, h.n + 1):
+        h.set_chaos(i, rpc_delay_s=0.1)
+    try:
+        legacy = NodeClient(host="127.0.0.1", port=h.http_port(1))
+        t0 = time.monotonic()
+        for fid, want in zip(fids, corpus):
+            assert legacy.download(fid) == want
+        t_legacy = time.monotonic() - t0
+
+        cs = _smart(h, 1)
+        t0 = time.monotonic()
+        for fid, want in zip(fids, corpus):
+            assert cs.download(fid) == want
+        t_smart = time.monotonic() - t0
+        assert cs.counters["smartDownloads"] == n_files
+    finally:
+        for i in range(1, h.n + 1):
+            h.set_chaos(i, rpc_delay_s=0.0)
+    speedup = t_legacy / max(t_smart, 1e-9)
+    return {"ok": speedup >= 2.0, "files": n_files,
+            "fileBytes": file_bytes,
+            "legacyS": round(t_legacy, 3), "stripedS": round(t_smart, 3),
+            "speedup": round(speedup, 2), "floor": 2.0}
+
+
+# ------------------------------------------------------------------ #
+# gate 3: stale filter + slow replica — verified, never lossy
+# ------------------------------------------------------------------ #
+
+def gate_verified_stale_and_slow(h: ClusterHarness, tiny: bool) -> dict:
+    file_bytes = 512 * 1024 if tiny else 8 * 1024 * 1024
+    data = _corpus(1, file_bytes, seed=83)[0]
+    c = _smart(h, 1, hedge_budget_per_s=20.0, hedge_floor_s=0.05,
+               hedge_cap_s=0.5)
+    # warm the filter fetch, then corrupt ONE peer's replica at the
+    # client: all ones = "I have everything" — the worst stale filter
+    c.upload(_corpus(1, 64 * 1024, seed=5)[0], name="warm.bin")
+    assert c._filters, "client fetched no filters"
+    victim = sorted(c._filters)[0]
+    buf = c._filters[victim]["bloom"].buf
+    for i in range(len(buf)):
+        buf[i] = 0xFF
+    h.set_chaos(h.n, serve_delay_s=0.25)   # one slow replica
+    try:
+        info = c.upload(data, name="fresh.bin")
+        assert info["dataPlane"] == "smart", info
+        got = c.download(info["fileId"])
+    finally:
+        h.set_chaos(h.n, serve_delay_s=0.0)
+    chunks = info["chunks"]
+    byte_ok = got == data
+    # ... and the acked bytes read back through every node's legacy path
+    for i in range(1, h.n + 1):
+        byte_ok = byte_ok and \
+            NodeClient(host="127.0.0.1",
+                       port=h.http_port(i)).download(info["fileId"]) == data
+    ok = byte_ok and c.counters["chunksVerified"] >= chunks \
+        and c.counters["filterFp"] > 0
+    return {"ok": ok, "chunks": chunks, "byteIdentical": byte_ok,
+            "chunksVerified": c.counters["chunksVerified"],
+            "filterFp": c.counters["filterFp"],
+            "healedChunks": c.counters["healedChunks"],
+            "hedge": (c._hedge.stats() if c._hedge else None)}
+
+
+# ------------------------------------------------------------------ #
+# gate 4: wire compatibility both directions
+# ------------------------------------------------------------------ #
+
+def gate_interop(h: ClusterHarness, tiny: bool) -> dict:
+    data = _corpus(1, 300 * 1024, seed=7)[0]
+    # legacy client against the new servers
+    legacy = NodeClient(host="127.0.0.1", port=h.http_port(1))
+    info = legacy.upload(data, "legacy.bin")
+    legacy_ok = legacy.download(info["fileId"]) == data
+
+    # new client pinned to the coordinator-only path (the fallback the
+    # smart plane degrades to on old servers / epoch churn)
+    pinned = SmartClient(host="127.0.0.1", port=h.http_port(2),
+                         cfg=ClientConfig())
+    pinned._boot = False                  # what a /dataplane 404 sets
+    info2 = pinned.upload(data, "pinned.bin")
+    pinned_ok = info2["dataPlane"] == "legacy" \
+        and info2["fileId"] == info["fileId"] \
+        and pinned.download(info2["fileId"]) == data
+
+    # and the smart path reads what the legacy path wrote
+    cross = _smart(h, 3).download(info["fileId"]) == data
+    ok = legacy_ok and pinned_ok and cross
+    return {"ok": ok, "legacyClientOk": legacy_ok,
+            "pinnedClientOk": pinned_ok, "crossReadOk": cross}
+
+
+# ------------------------------------------------------------------ #
+
+def run(tmp: Path, tiny: bool) -> dict:
+    h = ClusterHarness(3, tmp / "cluster", rf=RF, extra_flags=[
+        "--index", "--index-filter-sync", "0.5",
+        "--index-background-compact", "--index-echo-cache", "4096"])
+    h.start_all()
+    h.wait_ready()
+    out: dict = {"metric": "client_data_plane", "round": 19,
+                 "tiny": tiny, "gates": {}}
+    try:
+        for name, fn in (("dedup_reupload", gate_dedup_reupload),
+                         ("striped_speedup", gate_striped_speedup),
+                         ("verified_stale_and_slow",
+                          gate_verified_stale_and_slow),
+                         ("interop", gate_interop)):
+            log(f"=== {name} ===")
+            t0 = time.monotonic()
+            out["gates"][name] = fn(h, tiny)
+            out["gates"][name]["wallS"] = round(time.monotonic() - t0, 2)
+            log(f"    {json.dumps(out['gates'][name])}")
+    finally:
+        h.stop_all()
+    out["ok"] = all(g["ok"] for g in out["gates"].values())
+    out["cmd"] = "python bench_client.py" + (" --tiny" if tiny else "")
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tiny", action="store_true",
+                    help="tier-1 smoke mode: small corpus — same "
+                         "gates, same cluster shape")
+    ap.add_argument("--out", default=None,
+                    help=f"artifact path (default: {ART} next to this "
+                         "script)")
+    args = ap.parse_args(argv)
+    out_path = Path(args.out) if args.out \
+        else Path(__file__).parent / ART
+    with tempfile.TemporaryDirectory(prefix="bench_client_") as tmp:
+        out = run(Path(tmp), args.tiny)
+    out_path.write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

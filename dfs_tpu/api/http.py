@@ -258,7 +258,7 @@ _TRACED_ROUTES = frozenset({
     "/status", "/files", "/metrics", "/manifest", "/chunking", "/missing",
     "/upload_resume", "/upload", "/download", "/scrub", "/repair",
     "/trace", "/events", "/doctor", "/census", "/metrics/history",
-    "/chaos", "/ring"})
+    "/chaos", "/ring", "/dataplane", "/commit"})
 
 # routes the CONFIGURED default deadline applies to: the client-facing
 # data plane. Maintenance/diagnosis endpoints (/repair, /scrub,
@@ -268,7 +268,7 @@ _TRACED_ROUTES = frozenset({
 # X-Dfs-Deadline header is honored on any route (the caller asked).
 _DEADLINE_DEFAULT_ROUTES = frozenset({
     "/download", "/upload", "/upload_resume", "/missing", "/chunking",
-    "/manifest", "/files"})
+    "/manifest", "/files", "/commit"})
 
 
 async def _serve_one(node: "StorageNodeServer",
@@ -563,6 +563,59 @@ async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
             return plain(404, "Fragmenter not resume-describable")
         return as_json(200, {"fragmenter": node.fragmenter.name,
                              "describe": desc})
+
+    if method == "GET" and path == "/dataplane":
+        # smart-client bootstrap (docs/client.md): ring map + peer
+        # address book + chunking description + filter state in one
+        # call. Old servers 404 this path — the client's cue to fall
+        # back to the coordinator data plane.
+        return as_json(200, node.dataplane_info())
+
+    if method == "POST" and path == "/commit":
+        # single-hop ingest commit (docs/client.md): the client striped
+        # payloads straight to the ring owners; this call carries ONLY
+        # the chunk table. body: [u32 json_len][json {fileId,size,
+        # chunks}] — same framing family as /upload_resume, zero
+        # payload section.
+        if content_length is None:
+            return plain(411, "Length Required")
+        if content_length > 64 * 1024 * 1024:
+            return plain(413, "Payload Too Large")
+        gate = node.serve.admission.upload
+        try:
+            await gate.acquire()   # shed BEFORE buffering the body
+        except ShedError as e:
+            return _shed(node, e)
+        try:
+            raw = await reader.readexactly(content_length)
+            try:
+                jlen = int.from_bytes(raw[:4], "big")
+                meta = json.loads(raw[4:4 + jlen])
+                if 4 + jlen != len(raw):
+                    raise ValueError("trailing bytes after table")
+                table = [(int(o), int(ln), str(dg))
+                         for o, ln, dg in meta["chunks"]]
+                file_id, size = str(meta["fileId"]), int(meta["size"])
+            except (KeyError, ValueError, TypeError) as e:
+                return plain(400, f"Bad commit frame: {e}")
+            if _bad_id(file_id):
+                return plain(400, "Bad fileId")
+            try:
+                manifest, stats = await node.commit_manifest(
+                    table, query.get("name", ""), file_id, size)
+            except (DeadlineExpired, DeadlineExceeded) as e:
+                return _deadline_503(node, e)
+            except UploadError as e:
+                # 409 = chunks not durably present (client falls back
+                # to a full upload); 400 = bad table; 500 = placement
+                return plain(e.status, str(e))
+            return as_json(201, {"fileId": manifest.file_id,
+                                 "name": manifest.name,
+                                 "size": manifest.size,
+                                 "chunks": manifest.total_chunks,
+                                 **stats})
+        finally:
+            gate.release()
 
     if method == "POST" and path == "/missing":
         if content_length is None:

@@ -28,6 +28,26 @@ def _client(args) -> NodeClient:
     return NodeClient(host=args.host, port=args.port)
 
 
+def _smart_client(args):
+    """--smart: build the SDK data-plane client (docs/client.md) from
+    the --client-* knobs; everything still degrades to the coordinator
+    path unless --client-no-fallback."""
+    from dfs_tpu.client import SmartClient
+    from dfs_tpu.config import ClientConfig
+
+    cfg = ClientConfig(
+        window=args.client_window,
+        stripe=args.client_stripe,
+        hedge_budget_per_s=args.client_hedge_budget,
+        hedge_floor_s=args.client_hedge_floor,
+        hedge_cap_s=args.client_hedge_cap,
+        filter_max_age_s=args.client_filter_max_age,
+        echo_cache_entries=args.client_echo_cache,
+        fallback=not args.client_no_fallback,
+    )
+    return SmartClient(host=args.host, port=args.port, cfg=cfg)
+
+
 def cmd_serve(args) -> int:
     from dfs_tpu.node.runtime import StorageNodeServer
 
@@ -97,7 +117,9 @@ def cmd_serve(args) -> int:
             memtable_entries=args.index_memtable_entries,
             compact_runs=args.index_compact_runs,
             filter_bits_per_key=args.index_filter_bits,
-            filter_sync_s=args.index_filter_sync),
+            filter_sync_s=args.index_filter_sync,
+            background_compact=args.index_background_compact,
+            echo_cache_entries=args.index_echo_cache),
         chaos=ChaosConfig(
             enabled=args.chaos,
             seed=args.chaos_seed,
@@ -213,6 +235,17 @@ def cmd_upload(args) -> int:
     data = path.read_bytes()
     ec = getattr(args, "ec", 0)
     trace_id = _maybe_trace_id(args)
+    if getattr(args, "smart", False):
+        if ec or getattr(args, "resume", False):
+            print("--smart is mutually exclusive with --ec/--resume "
+                  "(the SDK has its own dedup probe; EC needs the "
+                  "whole-body coordinator path)", file=sys.stderr)
+            return 2
+        info = _smart_client(args).upload(data, name=path.name)
+        print(f"Uploaded ({info['dataPlane']}): fileId={info['fileId']} "
+              f"chunks={info['chunks']} "
+              f"clientSent={info['clientBytesSent']}B of {len(data)}B")
+        return 0
     if getattr(args, "resume", False):
         if ec:
             print("--ec and --resume are mutually exclusive "
@@ -243,7 +276,13 @@ def cmd_download(args) -> int:
     c = _client(args)
     file_id = args.file_id
     trace_id = _maybe_trace_id(args)
-    data = c.download(file_id, trace_id=trace_id)
+    if getattr(args, "smart", False):
+        sc = _smart_client(args)
+        data = sc.download(file_id)
+        plane = "legacy" if sc.counters["legacyDownloads"] else "smart"
+        print(f"dataPlane={plane}")
+    else:
+        data = c.download(file_id, trace_id=trace_id)
     if trace_id:
         print(f"traceId={trace_id}")
     # Resolve the friendly name like the reference client (downloads/<name>,
@@ -672,6 +711,15 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--index-filter-sync", type=float, default=5.0,
                        help="peer-filter gossip cadence (s); 0 = no "
                             "background filter exchange")
+    serve.add_argument("--index-background-compact", action="store_true",
+                       help="run full index compactions on a dedicated "
+                            "thread instead of the CAS workers (stall "
+                            "attribution in /metrics index.compactStallS)")
+    serve.add_argument("--index-echo-cache", type=int, default=0,
+                       help="per-peer echo-confirmed existence cache "
+                            "entries (0 = off): a digest whose hash-echo "
+                            "was confirmed this ring epoch skips even "
+                            "the trust-verification probe on re-upload")
     serve.add_argument("--chaos", action="store_true",
                        help="enable the fault-injection plane "
                             "(docs/chaos.md): the knobs below apply "
@@ -730,6 +778,35 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("status").set_defaults(fn=cmd_status)
     sub.add_parser("list").set_defaults(fn=cmd_list)
+    def _add_client_flags(p):
+        """--smart data-plane knobs (ClientConfig, docs/client.md)."""
+        p.add_argument("--smart", action="store_true",
+                       help="use the SDK data plane: chunk+hash locally, "
+                            "consult peer-existence filters, stripe "
+                            "directly to the rf ring owners, one-call "
+                            "commit; falls back to the coordinator path "
+                            "on old servers / epoch churn")
+        p.add_argument("--client-window", type=int, default=2,
+                       help="store slices in flight per peer")
+        p.add_argument("--client-stripe", type=int, default=4,
+                       help="concurrent read batches across owners")
+        p.add_argument("--client-hedge-budget", type=float, default=0.0,
+                       help="hedged read/write budget (fires/s); 0 = "
+                            "no client-side hedging")
+        p.add_argument("--client-hedge-floor", type=float, default=0.05,
+                       help="minimum hedge delay (s)")
+        p.add_argument("--client-hedge-cap", type=float, default=1.0,
+                       help="maximum hedge delay (s)")
+        p.add_argument("--client-filter-max-age", type=float, default=30.0,
+                       help="peer-existence filter freshness bound (s); "
+                            "older replicas degrade to probes")
+        p.add_argument("--client-echo-cache", type=int, default=4096,
+                       help="echo-confirmed existence cache entries per "
+                            "peer (0 = always run the trust probe)")
+        p.add_argument("--client-no-fallback", action="store_true",
+                       help="raise instead of degrading to the legacy "
+                            "coordinator path (testing/benchmarks)")
+
     up = sub.add_parser("upload")
     up.add_argument("file")
     up.add_argument("--resume", action="store_true",
@@ -741,6 +818,7 @@ def build_parser() -> argparse.ArgumentParser:
     up.add_argument("--trace", action="store_true",
                     help="tag the request with a fresh trace id "
                          "(printed) for `trace <id>` inspection")
+    _add_client_flags(up)
     up.set_defaults(fn=cmd_upload)
     down = sub.add_parser("download")
     down.add_argument("file_id")
@@ -748,6 +826,7 @@ def build_parser() -> argparse.ArgumentParser:
     down.add_argument("--trace", action="store_true",
                       help="tag the request with a fresh trace id "
                            "(printed) for `trace <id>` inspection")
+    _add_client_flags(down)
     down.set_defaults(fn=cmd_download)
     rm = sub.add_parser("delete")
     rm.add_argument("file_id")

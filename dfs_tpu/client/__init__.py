@@ -1,0 +1,21 @@
+"""Smart client data plane (docs/client.md, ISSUE 16 tentpole).
+
+A programmatic SDK that moves the data plane to the client's edge:
+chunk + hash locally with the cluster's exact fragmenter parameters,
+consult the cluster's peer-existence filters to send only what is
+missing, stripe payloads DIRECTLY to the rf ring owners over the binary
+storage plane (bounded per-peer windows, per-slice hash-echo
+verification), and commit with ONE coordinator call — the single-hop
+ingest protocol. Downloads stripe reads across the owners with
+client-side budget-capped hedging and re-verify every chunk's digest
+(plus the whole-stream hash) at the client.
+
+Everything degrades transparently to the legacy coordinator path
+(:class:`dfs_tpu.cli.client.NodeClient`): old servers (no /dataplane),
+epoch mismatches, unreachable owners, undescribable fragmenters, EC
+manifests, range reads. The fallback matrix lives in docs/client.md.
+"""
+
+from dfs_tpu.client.smart import SmartClient, SmartClientError
+
+__all__ = ["SmartClient", "SmartClientError"]

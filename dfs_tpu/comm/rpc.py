@@ -654,6 +654,28 @@ class InternalClient:
         meta = resp.get("filter")
         return (meta if isinstance(meta, dict) else None), body
 
+    async def get_filters(self, peer: PeerAddr,
+                          retries: int | None = None
+                          ) -> list[tuple[dict, memoryview]]:
+        """Batched existence-filter fetch (docs/client.md): every
+        filter replica the peer holds — its OWN filter first, then its
+        replicas of the other nodes' — as (meta, filter-bytes view)
+        pairs. Each meta carries nodeId/gen/version/capacity/bitsPerKey/
+        ageS/length; the blobs ride concatenated in table order in one
+        reply body. Lets an external smart client learn the whole
+        cluster's existence summaries from ONE peer. Empty on a peer
+        with no filter plane; pre-r19 peers answer unknown-op (an
+        RpcRemoteError — callers degrade to probing)."""
+        resp, body = await self.call(peer, {"op": "get_filters"},
+                                     retries=retries)
+        out: list[tuple[dict, memoryview]] = []
+        off = 0
+        for meta in resp.get("filters", []):
+            ln = int(meta.get("length", 0))
+            out.append((meta, body[off:off + ln]))
+            off += ln
+        return out
+
     async def filter_delta(self, peer: PeerAddr, gen: int, since: int,
                            retries: int | None = None) -> dict:
         """Incremental filter update from (generation, version): the

@@ -59,6 +59,14 @@ peers that predate the ops answer "unknown op", which the filter sync
 loop treats as "no filter plane" — compatibility is bidirectional like
 the ``trace`` field.
 
+Round 19 adds ``get_filters`` (docs/client.md): a BATCHED filter fetch
+for external smart clients — one node replies with its own filter plus
+every peer-filter replica it gossips, as a meta table in the header
+(node id, generation, version, capacity, bits/key, age, blob length)
+and the raw blobs concatenated in table order as the body. Optional
+like the r16 ops: an old server answers "unknown op" and the client
+degrades to per-peer ``get_filter`` or plain probing.
+
 The stream-based :func:`send_msg` / :func:`read_msg` remain the
 compatibility surface (tests, tooling, pre-r10 interop): the bytes on
 the wire are identical.
@@ -131,6 +139,9 @@ OP_SPECS = {
     "filter_delta": {"request": ["gen", "since"],
                      "reply": ["resync", "gen", "version", "adds"],
                      "body": None},
+    "get_filters": {"request": [], "reply": ["filters"],
+                    "body": "reply: concatenated filter blobs "
+                            "(table in header)"},
 }
 
 # one payload buffer; a frame body is one of these or a sequence of them

@@ -508,6 +508,15 @@ class IndexConfig:
                                     # 0 = no filters (index only)
     filter_sync_s: float = 5.0      # filter gossip cadence (s);
                                     # 0 = no background exchange
+    background_compact: bool = False  # run full compactions on a
+                                    # dedicated thread instead of the
+                                    # CAS worker that tripped them;
+                                    # False = historical inline merge
+    echo_cache_entries: int = 0     # per-peer LRU of digests whose
+                                    # hash-echo was confirmed this
+                                    # session (skips even the pre-ack
+                                    # verify round on re-upload);
+                                    # 0 = no cache (verify every time)
 
     def __post_init__(self) -> None:
         if self.memtable_entries < 256:
@@ -518,6 +527,58 @@ class IndexConfig:
             raise ValueError("filter_bits_per_key must be >= 0")
         if self.filter_sync_s < 0:
             raise ValueError("filter_sync_s must be >= 0")
+        if self.echo_cache_entries < 0:
+            raise ValueError("echo_cache_entries must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientConfig:
+    """Smart-client data plane (dfs_tpu.client, docs/client.md).
+
+    Knobs of the edge SDK that chunks/hashes locally, consults the
+    cluster's ring + peer-existence filters, and stripes transfers
+    directly to the rf ring owners (single coordinator call only for
+    the manifest commit). Every knob here must surface as a CLI flag
+    on ``upload``/``download`` and as a key in ``SmartClient.stats()``
+    (dfslint DFS005 checks both mappings). Defaults are the
+    conservative shape: striping on (the SDK is only built when asked
+    for), client-side hedging OFF, transparent legacy fallback ON.
+    """
+
+    window: int = 2             # upload slices in flight PER OWNER
+                                # (the comm/rpc.py slice-pipelining
+                                # discipline); 1 = serial slices
+    stripe: int = 4             # peers a striped download reads from
+                                # concurrently; 1 = effectively serial
+    hedge_budget_per_s: float = 0.0  # client hedge token refill per
+                                # second (serve/hedge.py shapes);
+                                # 0 = client-side hedging off
+    hedge_floor_s: float = 0.05  # minimum client hedge delay
+    hedge_cap_s: float = 1.0    # maximum client hedge delay
+    filter_max_age_s: float = 30.0  # peer-existence filters older than
+                                # this are refetched before an upload;
+                                # 0 = refetch every upload
+    echo_cache_entries: int = 4096  # per-peer LRU of digests whose
+                                # hash-echo this client saw confirmed;
+                                # 0 = verify-round every re-upload
+    fallback: bool = True       # degrade transparently to the legacy
+                                # coordinator path (epoch mismatch, old
+                                # servers, unreachable owners); False =
+                                # raise instead (benches / tests)
+
+    def __post_init__(self) -> None:
+        if self.window < 1:
+            raise ValueError("window must be >= 1")
+        if self.stripe < 1:
+            raise ValueError("stripe must be >= 1")
+        if self.hedge_budget_per_s < 0:
+            raise ValueError("hedge_budget_per_s must be >= 0")
+        if self.hedge_floor_s < 0 or self.hedge_cap_s < self.hedge_floor_s:
+            raise ValueError("need 0 <= hedge_floor_s <= hedge_cap_s")
+        if self.filter_max_age_s < 0:
+            raise ValueError("filter_max_age_s must be >= 0")
+        if self.echo_cache_entries < 0:
+            raise ValueError("echo_cache_entries must be >= 0")
 
 
 @dataclasses.dataclass(frozen=True)

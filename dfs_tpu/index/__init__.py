@@ -20,6 +20,7 @@ tests/test_index.py).
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from pathlib import Path
 
 from dfs_tpu.index.filter import (DELTA_CAP, BlockedBloomFilter,
@@ -30,6 +31,68 @@ from dfs_tpu.index.lsi import DigestIndex
 # deliberately NOT the peer-filter knob: the peer exchange can be off
 # (filter_bits_per_key=0) while lookups still want run skipping
 _RUN_BLOOM_BITS = 10
+
+
+class EchoCache:
+    """Per-peer bounded LRU of digests whose presence on that peer was
+    *hash-echo confirmed this session* — the peer itself hashed the
+    payload and echoed the digest back (``store_chunks`` echo), or
+    answered a pre-ack ``has_chunks`` verification round. Unlike a
+    bloom positive this is first-party evidence, so a cache hit skips
+    even the trust-verification round on re-upload (ISSUE 16 satellite;
+    the r16 ledger still covers everything the cache cannot vouch for).
+
+    Scoped to one ring epoch: a membership change moves digest
+    ownership, so ``note_epoch`` with a new epoch drops everything —
+    stale epochs must never vouch for placement under a new map. A
+    peer's death drops its shard (``drop``): the confirmation was about
+    THAT process's durable store; its restart re-earns entries.
+
+    Single-owner affinity (event loop on the node, the caller's thread
+    in the SDK) — no locks, matching the placement counters."""
+
+    def __init__(self, per_peer: int) -> None:
+        self.per_peer = max(1, int(per_peer))
+        self._peers: dict[int, OrderedDict] = {}
+        self.epoch: int | None = None
+        self.hits = 0
+        self.confirms = 0
+        self.invalidations = 0
+
+    def note_epoch(self, epoch: int) -> None:
+        """Pin the cache to a ring epoch; a DIFFERENT epoch than the
+        pinned one clears every entry (ownership moved)."""
+        if self.epoch is not None and epoch != self.epoch:
+            self._peers.clear()
+            self.invalidations += 1
+        self.epoch = epoch
+
+    def confirm(self, peer: int, digest: str) -> None:
+        lru = self._peers.setdefault(peer, OrderedDict())
+        if digest in lru:
+            lru.move_to_end(digest)
+        else:
+            lru[digest] = None
+            if len(lru) > self.per_peer:
+                lru.popitem(last=False)
+        self.confirms += 1
+
+    def confirmed(self, peer: int, digest: str) -> bool:
+        lru = self._peers.get(peer)
+        if lru is None or digest not in lru:
+            return False
+        lru.move_to_end(digest)
+        self.hits += 1
+        return True
+
+    def drop(self, peer: int) -> None:
+        self._peers.pop(peer, None)
+
+    def stats(self) -> dict:
+        return {"entries": sum(len(v) for v in self._peers.values()),
+                "perPeerCap": self.per_peer,
+                "hits": self.hits, "confirms": self.confirms,
+                "invalidations": self.invalidations}
 
 
 class IndexPlane:
@@ -46,17 +109,24 @@ class IndexPlane:
             Path(root) / "index",
             memtable_entries=cfg.memtable_entries,
             compact_runs=cfg.compact_runs,
-            bloom_bits_per_key=_RUN_BLOOM_BITS)
+            bloom_bits_per_key=_RUN_BLOOM_BITS,
+            background_compact=getattr(cfg, "background_compact",
+                                       False))
         self.local_filter: LocalFilter | None = None
         self.peer_filters = PeerFilterSet()
         if cfg.filter_bits_per_key > 0:
             self.local_filter = LocalFilter(
                 bits_per_key=cfg.filter_bits_per_key)
             self.lsi.on_compact = self.local_filter.rebuild
+        self.echo_cache: EchoCache | None = None
+        if getattr(cfg, "echo_cache_entries", 0) > 0:
+            self.echo_cache = EchoCache(cfg.echo_cache_entries)
         # placement probe-skipping accounting (event loop only)
         self.probes_skipped = 0       # digests never probed over RPC
         self.probe_rpcs_skipped = 0   # whole has_chunks RPCs elided
         self.trusted = 0              # filter-positive copies credited
+        self.echo_trusted = 0         # echo-cache copies credited
+                                      # (skip ledger AND verify round)
 
     # ---- ChunkStore seam (CAS worker threads) ------------------------ #
 
@@ -101,12 +171,16 @@ class IndexPlane:
                "probesSkipped": self.probes_skipped,
                "probeRpcsSkipped": self.probe_rpcs_skipped,
                "filterTrusted": self.trusted,
-               "filterFp": self.peer_filters.fp_observed}
+               "filterFp": self.peer_filters.fp_observed,
+               "echoTrusted": self.echo_trusted}
         if self.local_filter is not None:
             out["filter"] = self.local_filter.stats()
             out["peerFilters"] = self.peer_filters.stats()
+        if self.echo_cache is not None:
+            out["echoCache"] = self.echo_cache.stats()
         return out
 
 
 __all__ = ["IndexPlane", "DigestIndex", "LocalFilter",
-           "BlockedBloomFilter", "PeerFilterSet", "DELTA_CAP"]
+           "BlockedBloomFilter", "PeerFilterSet", "EchoCache",
+           "DELTA_CAP"]

@@ -270,6 +270,21 @@ class PeerFilterSet:
     def drop(self, peer: int) -> None:
         self._peers.pop(peer, None)
 
+    def replicas(self) -> list[tuple[int, dict, bytes]]:
+        """Every held replica as ``(peer, meta, filter bytes)`` — the
+        batched ``get_filters`` exchange serves these so an external
+        client can learn the whole cluster's existence summaries from
+        ONE node (each meta carries ``ageS`` so the client can judge
+        staleness against its own freshness bound)."""
+        now = time.monotonic()
+        return [(p, {"nodeId": p, "gen": st["gen"],
+                     "version": st["version"],
+                     "capacity": st["bloom"].capacity,
+                     "bitsPerKey": st["bloom"].bits_per_key,
+                     "ageS": round(now - st["syncedAt"], 3)},
+                 bytes(st["bloom"].buf))
+                for p, st in sorted(self._peers.items())]
+
     def ages(self) -> dict[int, float]:
         now = time.monotonic()
         return {p: now - st["syncedAt"]

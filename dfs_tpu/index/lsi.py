@@ -54,6 +54,7 @@ import json
 import os
 import struct
 import threading
+import time
 import zlib
 from bisect import bisect_right
 from pathlib import Path
@@ -180,12 +181,13 @@ class DigestIndex:
     """
 
     def __init__(self, root: Path, memtable_entries: int = 65536,
-                 compact_runs: int = 4, bloom_bits_per_key: int = 10
-                 ) -> None:
+                 compact_runs: int = 4, bloom_bits_per_key: int = 10,
+                 background_compact: bool = False) -> None:
         self.root = Path(root)
         self.memtable_entries = max(256, int(memtable_entries))
         self.compact_runs = max(1, int(compact_runs))
         self.bloom_bits_per_key = max(0, int(bloom_bits_per_key))
+        self.background_compact = bool(background_compact)
         self.hook: Callable[[str], None] | None = None
         self.on_event: Callable[..., None] | None = None
         # on_compact(present_digest_iter, count): the filter plane's
@@ -204,6 +206,18 @@ class DigestIndex:
         self._compactions = 0
         self._rebuilds = 0
         self._wal_records = 0
+        # background-compaction plumbing (ISSUE 16 satellite): the cv
+        # shares the index lock, the thread starts lazily on the first
+        # requested merge, and the stall counters attribute merge time
+        # to whoever paid it — a CAS worker (inline mode: the r16
+        # behavior, where one put froze behind a multi-second merge) or
+        # the dedicated thread (background mode)
+        self._compact_cv = threading.Condition(self._lock)
+        self._compact_thread: threading.Thread | None = None
+        self._compact_wanted = False
+        self._closed = False
+        self._compact_stall_s = 0.0   # merge seconds paid by callers
+        self._bg_compact_s = 0.0      # merge seconds on the thread
 
     # ---------------------------------------------------------------- #
     # open / rebuild
@@ -509,7 +523,52 @@ class DigestIndex:
         (self.root / old_wal).unlink(missing_ok=True)
         self._memtable = {}
         self._wal_records = 0
+        self._request_compact_locked()
+
+    def _request_compact_locked(self) -> None:
+        """Route a due compaction: inline on the calling (CAS worker)
+        thread — the historical behavior, its cost attributed to
+        ``compactStallS`` — or handed to the dedicated thread when
+        ``background_compact`` (the caller returns immediately; the
+        worker never stalls behind the merge)."""
+        if self._compacting or len(self._runs) <= self.compact_runs:
+            return
+        if self.background_compact:
+            self._compact_wanted = True
+            if self._compact_thread is None and not self._closed:
+                self._compact_thread = threading.Thread(
+                    target=self._compact_loop,
+                    name="dfs-index-compact", daemon=True)
+                self._compact_thread.start()
+            self._compact_cv.notify_all()
+            return
+        t0 = time.monotonic()
         self._maybe_compact_locked()
+        self._compact_stall_s += time.monotonic() - t0
+
+    def _compact_loop(self) -> None:
+        """Dedicated compaction thread: waits for a due merge, runs it,
+        repeats. The chaos ``index.compact`` crash point now fires on
+        this thread — SIGKILL semantics are process-wide, so the crash
+        tests' commit-edge kill window is unchanged."""
+        with self._lock:
+            while True:
+                while not self._compact_wanted and not self._closed:
+                    self._compact_cv.wait()
+                if self._closed:
+                    return
+                self._compact_wanted = False
+                t0 = time.monotonic()
+                self._maybe_compact_locked()
+                self._bg_compact_s += time.monotonic() - t0
+                self._compact_cv.notify_all()
+
+    def drain_compaction(self) -> None:
+        """Block until no compaction is pending or running — test /
+        bench determinism; an inline-mode index returns immediately."""
+        with self._lock:
+            while self._compact_wanted or self._compacting:
+                self._compact_cv.wait(timeout=0.05)
 
     def _maybe_compact_locked(self) -> None:
         """Fold every current run into one base run, newest record
@@ -566,6 +625,7 @@ class DigestIndex:
         self._write_current_locked()          # the commitment point
         self._compacting = False
         self._compactions += 1
+        self._compact_cv.notify_all()         # wake drain_compaction
         # observer callbacks off the lock: the filter rebuild
         # (on_compact) is an O(entries) bloom build that must not
         # stall every note/lookup behind it
@@ -639,6 +699,18 @@ class DigestIndex:
             self._flush_wal_locked()
 
     def close(self) -> None:
+        # stop the compaction thread first (join OUTSIDE the lock — a
+        # mid-merge thread needs the lock to commit before it exits);
+        # a still-pending wanted-compaction is simply dropped: the run
+        # files are the persisted index either way, and the next life
+        # re-triggers the merge at its first flush
+        with self._lock:
+            self._closed = True
+            self._compact_cv.notify_all()
+            t = self._compact_thread
+            self._compact_thread = None
+        if t is not None:
+            t.join(timeout=30.0)
         with self._lock:
             self._flush_wal_locked()
             if self._wal_fd is not None:
@@ -677,4 +749,10 @@ class DigestIndex:
                 "walRecords": self._wal_records,
                 "compactions": self._compactions,
                 "rebuilds": self._rebuilds,
+                # stall attribution: merge seconds paid inline by CAS
+                # workers vs on the dedicated thread — backgrounding is
+                # working exactly when the first stays ~0 while the
+                # second (and ``compactions``) grows
+                "compactStallS": round(self._compact_stall_s, 6),
+                "bgCompactS": round(self._bg_compact_s, 6),
             }

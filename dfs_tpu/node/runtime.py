@@ -781,7 +781,9 @@ class StorageNodeServer:
                "memtableEntries": c.memtable_entries,
                "compactRuns": c.compact_runs,
                "filterBitsPerKey": c.filter_bits_per_key,
-               "filterSyncS": c.filter_sync_s}
+               "filterSyncS": c.filter_sync_s,
+               "backgroundCompact": c.background_compact,
+               "echoCacheEntries": c.echo_cache_entries}
         if self.index is not None:
             out.update(self.index.stats())
         return out
@@ -997,6 +999,32 @@ class StorageNodeServer:
                         "version": 0}, b""
             return {"ok": True,
                     **self.index.local_filter.delta(gen, since)}, b""
+        if op == "get_filters":
+            # batched filter fetch (docs/client.md): this node's own
+            # filter PLUS every peer-filter replica it gossips, so an
+            # external smart client learns the whole cluster's
+            # existence summaries in one round trip. Meta table in the
+            # header (blob lengths included), raw blobs concatenated in
+            # table order as the body — the pack_chunks shape without
+            # digests. Cheap metadata, ungated like get_filter; a node
+            # with no filter plane answers an empty table.
+            metas: list[dict] = []
+            blobs: list[bytes] = []
+            if self.index is not None \
+                    and self.index.local_filter is not None:
+                fmeta, blob = self.index.local_filter.snapshot()
+                metas.append({"nodeId": self.cfg.node_id,
+                              "gen": fmeta["gen"],
+                              "version": fmeta["version"],
+                              "capacity": fmeta["capacity"],
+                              "bitsPerKey": fmeta["bitsPerKey"],
+                              "ageS": 0.0, "length": len(blob)})
+                blobs.append(blob)
+                for _pid, pmeta, pblob in \
+                        self.index.peer_filters.replicas():
+                    metas.append({**pmeta, "length": len(pblob)})
+                    blobs.append(pblob)
+            return {"ok": True, "filters": metas}, blobs
         if op == "announce":
             m = Manifest.from_json(header["manifest"])
             if header.get("fresh"):
@@ -1596,6 +1624,168 @@ class StorageNodeServer:
         self.counters.inc("upload_bytes", size)
         return manifest, stats
 
+    async def commit_manifest(self, table: list[tuple[int, int, str]],
+                              name: str, file_id: str, size: int
+                              ) -> tuple[Manifest, dict]:
+        """Single-hop ingest commit (docs/client.md): the smart client
+        already striped every payload directly to its ring owners with
+        per-slice hash-echo verification; this ONE coordinator call
+        turns that pre-staged state into an acked file. Ack semantics
+        are unchanged from a regular upload — the manifest write is
+        fsync-before-ack and nothing is acked until every chunk in the
+        table is confirmed AT WRITE QUORUM by real ``has_chunks``
+        rounds (a stale filter or a lying client cannot manufacture a
+        phantom copy: the coordinator re-counts durable copies itself,
+        and re-places anything below quorum through the normal batch
+        path). Chunks held nowhere reachable raise a 409-class
+        UploadError — the client falls back to a legacy full upload.
+
+        ``file_id`` on this path is the client's claim of
+        sha256(stream): the coordinator never saw the assembled bytes.
+        Per-chunk digests WERE verified at store time (the owners
+        hash-echo what they durably hold), and every read re-verifies
+        each chunk against the manifest — so a wrong claim can only
+        mis-name the file, never corrupt bytes (same trust model as
+        the chunk table itself; documented in docs/client.md)."""
+        if not name:
+            name = f"file-{file_id[:8]}"   # reference default naming
+        # table sanity: contiguous tiling of [0, size) — the same
+        # contract as upload_resume
+        expect = 0
+        for off, ln, dg in table:
+            if off != expect or ln < 0 or not is_hex_digest(dg):
+                raise UploadError("malformed chunk table", status=400)
+            expect = off + ln
+        if expect != size:
+            raise UploadError("chunk table does not tile the stream",
+                              status=400)
+        refs = [ChunkRef(index=i, offset=off, length=ln, digest=dg)
+                for i, (off, ln, dg) in enumerate(table)]
+        manifest = Manifest(file_id=file_id, name=name, size=size,
+                            fragmenter=self.fragmenter.name,
+                            chunks=tuple(refs))
+        stats = self._new_upload_stats()
+        stats["bytes"] = size
+
+        ring = self.ring.current
+        ids = ring.active_ids()
+        rf = self.cfg.cluster.replication_factor
+        quorum = min(self.cfg.write_quorum, rf, len(ids))
+        plane = self.index
+        cache = plane.echo_cache if plane is not None else None
+        digests = list(dict.fromkeys(dg for _, _, dg in table))
+        copies = {d: 0 for d in digests}
+        # local holdings first (this node is an owner for its arc)
+        mask = await self.cas.has_many(digests)
+        for d, h in zip(digests, mask):
+            if h:
+                copies[d] += 1
+        # one real has_chunks round per owner peer — first-party
+        # evidence, the same pre-ack discipline as _verify_trusted
+        by_peer: dict[int, list[str]] = {}
+        for d in digests:
+            for t in ring.owners(d, rf):
+                if t != self.cfg.node_id:
+                    by_peer.setdefault(t, []).append(d)
+
+        async def probe(nid: int, ds: list[str]) -> set[str]:
+            try:
+                resp, _ = await self.client.call(
+                    self.cfg.cluster.peer(nid),
+                    {"op": "has_chunks", "digests": ds},
+                    retries=None if self.health.is_alive(nid) else 1)
+                self.health.mark_alive(nid)
+                return set(resp.get("have", []))
+            except DeadlineExpired:
+                raise
+            except RpcError as e:
+                if isinstance(e, RpcUnreachable):
+                    self.health.mark_dead(nid)
+                self.counters.inc("commit_probe_failures")
+                return set()
+
+        with self.obs.span("upload.commit_verify", latency=True):
+            peers = sorted(by_peer)
+            results = await asyncio.gather(
+                *(probe(n, by_peer[n]) for n in peers))
+        for nid, have in zip(peers, results):
+            for d in by_peer[nid]:
+                if d in have:
+                    copies[d] += 1
+                    if cache is not None:
+                        cache.confirm(nid, d)
+        confirmed = {d: n for d, n in copies.items() if n >= quorum}
+        stats["dedupSkippedBytes"] = sum(
+            ln for _, ln, dg in table if dg in confirmed)
+        below = [d for d in digests if d not in confirmed]
+        if below:
+            # heal below-quorum chunks pre-ack: fetch the bytes (local
+            # CAS, then any replica — the client may have reached SOME
+            # owners) and re-place through the normal batch path, which
+            # re-probes, transfers, and falls to handoff as needed.
+            # Chunks absent everywhere 409 — the ack was never given.
+            self.obs.event("commit_replace", chunks=len(below))
+            need = [c for c in refs if c.digest in set(below)]
+            dedup: set[str] = set()
+            need = [c for c in need
+                    if not (c.digest in dedup or dedup.add(c.digest))]
+            fetched = await self._fetch_verified(manifest, need,
+                                                 strict=False)
+            absent = [c.digest for c in need if c.digest not in fetched]
+            if absent:
+                raise UploadError(
+                    "commit missing chunks: "
+                    + ",".join(d[:12] for d in absent), status=409)
+            await self._place_batch(
+                file_id, [(c.digest, fetched[c.digest]) for c in need],
+                stats)
+        stats["uniqueChunks"] = len(digests)
+        batch_min = min((confirmed[d] for d in confirmed), default=rf)
+        stats["minCopies"] = batch_min if stats["minCopies"] is None \
+            else min(stats["minCopies"], batch_min)
+        stats["degraded"] = stats["degraded"] or batch_min < rf
+        await self._finalize_upload(manifest)
+        self.counters.inc("uploads_committed")
+        self.counters.inc("upload_bytes", size)
+        return manifest, stats
+
+    def dataplane_info(self) -> dict:
+        """GET /dataplane (docs/client.md): one bootstrap call telling
+        an external smart client everything it needs to run the data
+        plane itself — the ring map (so it can compute owners), the
+        peer address book (so it can dial their storage-plane ports),
+        the replication policy (rf / write quorum), the fragmenter
+        description (so its chunk boundaries match the cluster's
+        bit-exactly), and the existence-filter state. Old servers 404
+        this route; the client falls back to the coordinator path."""
+        out = {"nodeId": self.cfg.node_id,
+               "epoch": self.ring.epoch,
+               "fingerprint": self.ring.current.fingerprint,
+               "ring": self.ring.current.to_dict(),
+               "migrating": self.ring.migrating,
+               "replicationFactor": self.cfg.cluster.replication_factor,
+               "writeQuorum": self.cfg.write_quorum,
+               "peers": [{"nodeId": p.node_id, "host": p.host,
+                          "port": p.port,
+                          "internalPort": p.internal_port}
+                         for p in self.cfg.cluster.peers],
+               "filters": {"enabled": False}}
+        try:
+            out["chunking"] = {"fragmenter": self.fragmenter.name,
+                               "describe": self.fragmenter.describe()}
+        except NotImplementedError:
+            out["chunking"] = None   # engine not resume-describable:
+            # the client cannot reproduce boundaries — legacy path only
+        if self.index is not None and self.index.local_filter is not None:
+            fstats = self.index.local_filter.stats()
+            out["filters"] = {
+                "enabled": True,
+                "generation": fstats["generation"],
+                "version": fstats["version"],
+                "peerAges": {str(p): round(a, 3) for p, a in
+                             self.index.peer_filters.ages().items()}}
+        return out
+
     @staticmethod
     def _new_upload_stats() -> dict:
         return {"bytes": 0, "uniqueChunks": 0, "transferredBytes": 0,
@@ -1687,6 +1877,11 @@ class StorageNodeServer:
         # either epoch; a half-and-half batch would satisfy neither)
         ring = self.ring.current
         ids = ring.active_ids()
+        if self.index is not None and self.index.echo_cache is not None:
+            # pin the echo cache to this batch's epoch: an adoption
+            # since the last batch clears every session confirmation
+            # (ownership moved — docs/client.md §filter freshness)
+            self.index.echo_cache.note_epoch(ring.epoch)
         if rf is None:
             rf = self.cfg.cluster.replication_factor
         placement = placement or {}
@@ -1755,14 +1950,39 @@ class StorageNodeServer:
             # the phantom the health registry exists to prevent); no
             # replica of the peer's filter = the pre-index path.
             plane = self.index
+            cache = plane.echo_cache if plane is not None else None
             trusted: set[str] = set()
-            to_probe = wanted
+            # echo-cache consult first (ISSUE 16 satellite): a digest
+            # this peer hash-echo-confirmed THIS SESSION under the
+            # current epoch is first-party evidence, stronger than a
+            # bloom positive — credit the copy with NO ledger entry,
+            # skipping the probe AND the pre-ack verify round. Dead
+            # peers never qualify (same rule as filter trust).
+            remaining = wanted
+            if cache is not None and retries is None:
+                echoed_skip = 0
+                remaining = []
+                for d, b in wanted:
+                    if cache.confirmed(node_id, d):
+                        echoed_skip += 1
+                        copies[d] += 1
+                        if (node_id, d) not in counted:
+                            counted.add((node_id, d))
+                            stats["dedupSkippedBytes"] += len(b)
+                    else:
+                        remaining.append((d, b))
+                if echoed_skip:
+                    plane.echo_trusted += echoed_skip
+                    plane.probes_skipped += echoed_skip
+            filtered = False
+            to_probe = remaining
             if plane is not None and plane.local_filter is not None \
                     and retries is None \
                     and plane.peer_filters.state(node_id) is not None:
+                filtered = True
                 ruled_out = 0
                 to_probe = []
-                for d, b in wanted:
+                for d, b in remaining:
                     verdict = plane.peer_filters.contains(node_id, d)
                     if verdict is False:
                         ruled_out += 1       # straight to transfer
@@ -1777,13 +1997,13 @@ class StorageNodeServer:
                         to_probe.append((d, b))
                 plane.probes_skipped += ruled_out + len(trusted)
                 plane.trusted += len(trusted)
-                if not to_probe and wanted:
+                if not to_probe and remaining:
                     plane.probe_rpcs_skipped += 1
             digests = [d for d, _ in to_probe]
             try:
                 staged = None
                 have: set[str] = set()
-                if to_probe is wanted:
+                if to_probe and not filtered:
                     # the has_chunks probe flies while the payload list
                     # is staged into bounded slices — fresh data rarely
                     # dedups, so the optimistic staging is usually
@@ -1801,7 +2021,7 @@ class StorageNodeServer:
                         # create_task would still serialize ahead of
                         # the wire write
                         staged = await asyncio.to_thread(
-                            self._slice_payloads, wanted,
+                            self._slice_payloads, remaining,
                             self._REPLICA_SLICE_BYTES)
                         resp, _ = await probe
                     except BaseException:
@@ -1821,13 +2041,15 @@ class StorageNodeServer:
                             # an OBSERVED false positive — counted, and
                             # overridden so a retry stops re-trusting
                             plane.peer_filters.note_fp(node_id, d)
-                missing = [(d, b) for d, b in wanted
+                missing = [(d, b) for d, b in remaining
                            if d not in have and d not in trusted]
-                for d, b in wanted:
+                for d, b in remaining:
                     if d in have:
                         # durable on the peer no matter what later
                         # slices do — credit the copy immediately
                         copies[d] += 1
+                        if cache is not None:
+                            cache.confirm(node_id, d)
                         if (node_id, d) not in counted:
                             counted.add((node_id, d))
                             stats["dedupSkippedBytes"] += len(b)
@@ -1844,30 +2066,70 @@ class StorageNodeServer:
                         else self._slice_payloads(
                             missing, self._REPLICA_SLICE_BYTES)
 
-                    def on_slice(part: list[tuple[str, bytes]],
-                                 echoed: list[str]) -> None:
-                        # hash-echo verification per slice (reference
-                        # contract, StorageNode.java:248-257) + per-slice
-                        # crediting: a verified slice is durable on the
-                        # peer even if a LATER slice fails — end-of-call
-                        # crediting forgot delivered bytes on partial
-                        # failure, and handoff re-transferred (and
-                        # re-counted) them
-                        sent = {d for d, _ in part}
-                        if sent & set(echoed) != sent:
-                            raise RpcError(
-                                f"hash echo mismatch from node {node_id}")
-                        for d, b in part:
-                            copies[d] += 1
-                            if (node_id, d) not in counted:
-                                counted.add((node_id, d))
-                                stats["transferredBytes"] += len(b)
+                    def make_on_slice(nid: int):
+                        def on_slice(part: list[tuple[str, bytes]],
+                                     echoed: list[str]) -> None:
+                            # hash-echo verification per slice (reference
+                            # contract, StorageNode.java:248-257) +
+                            # per-slice crediting: a verified slice is
+                            # durable on the peer even if a LATER slice
+                            # fails — end-of-call crediting forgot
+                            # delivered bytes on partial failure, and
+                            # handoff re-transferred (and re-counted)
+                            # them. The echo IS the session confirmation
+                            # the echo cache keys on.
+                            sent = {d for d, _ in part}
+                            if sent & set(echoed) != sent:
+                                raise RpcError(
+                                    f"hash echo mismatch from node {nid}")
+                            for d, b in part:
+                                copies[d] += 1
+                                if cache is not None:
+                                    cache.confirm(nid, d)
+                                if nid != node_id:
+                                    # hedge-backup copy: durable but on
+                                    # a non-canonical holder — queue it
+                                    # for repair like a handoff copy
+                                    self.under_replicated.add(d)
+                                if (nid, d) not in counted:
+                                    counted.add((nid, d))
+                                    stats["transferredBytes"] += len(b)
+                        return on_slice
 
-                    peak = await self.client.store_chunks_windowed(
-                        peer, file_id, slices,
-                        window=self.cfg.ingest.slice_inflight,
-                        on_slice=on_slice)
-                    self.ingest_stalls.peak("sliceInflight", peak)
+                    # hedged write (ISSUE 16 satellite): under a hedge
+                    # policy, race the slice train against a timer; if
+                    # the primary stalls past the p~99 envelope, open a
+                    # SECOND train to the next ring holder under the
+                    # shared token budget. Content-addressed puts make
+                    # the duplicate harmless — whichever copies land
+                    # are real copies — and per-slice crediting under
+                    # ``counted`` keeps the byte accounting exact.
+                    backup_id = None
+                    if self.serve.hedge is not None:
+                        # first digest in the batch with a live third
+                        # holder nominates the backup (the batch mixes
+                        # owner sets; anchoring on missing[0] alone
+                        # left whole trains unhedged on a coin flip)
+                        for dg, _ in missing:
+                            primaries = set(primary_targets(dg))
+                            backup_id = next(
+                                (t for t in handoff_ring(dg)
+                                 if t != node_id
+                                 and t != self.cfg.node_id
+                                 and t not in primaries
+                                 and self.health.is_alive(t)), None)
+                            if backup_id is not None:
+                                break
+                    if backup_id is None:
+                        peak = await self.client.store_chunks_windowed(
+                            peer, file_id, slices,
+                            window=self.cfg.ingest.slice_inflight,
+                            on_slice=make_on_slice(node_id))
+                        self.ingest_stalls.peak("sliceInflight", peak)
+                    else:
+                        await self._store_hedged(
+                            node_id, backup_id, file_id, slices,
+                            make_on_slice)
                 self.health.mark_alive(node_id)
             except DeadlineExpired:
                 # the caller's budget died, not the peer: abort the
@@ -1884,6 +2146,10 @@ class StorageNodeServer:
                     # only transport-level exhaustion is liveness evidence;
                     # an application error came from a live peer
                     self.health.mark_dead(node_id)
+                    if cache is not None:
+                        # session confirmations were about THAT process;
+                        # its successor re-earns them
+                        cache.drop(node_id)
 
         with self.obs.span("upload.replicate", latency=True):
             try:
@@ -1972,6 +2238,119 @@ class StorageNodeServer:
         stats["degraded"] = stats["degraded"] or bool(
             handoff or any(n < rf for n in copies.values()))
 
+    async def _store_hedged(self, primary_id: int, backup_id: int,
+                            file_id: str,
+                            slices: list[list[tuple[str, bytes]]],
+                            make_on_slice) -> None:
+        """Hedged replication store (ISSUE 16 satellite, the write-side
+        twin of :meth:`_hedged_get_chunks`): send the slice train to the
+        primary; if it outlives the latency-derived hedge delay and the
+        shared token bucket allows, open a SECOND train of the same
+        slices to ``backup_id``. Content-addressed puts make the
+        duplicate inherently safe — every hash-echo-verified slice is a
+        real durable copy wherever it landed, credited through the
+        caller's ``counted`` discipline — so unlike the read side there
+        is no result to pick: success of EITHER train completes the
+        call, and a loser cancelled mid-flight keeps the slices it
+        already landed. Exceptions propagate only when both trains fail
+        (the primary's error class, so the caller's health handling
+        stays aimed at the peer it chose)."""
+        hedge = self.serve.hedge
+        window = self.cfg.ingest.slice_inflight
+
+        async def issue(nid: int):
+            return await self.client.store_chunks_windowed(
+                self.cfg.cluster.peer(nid), file_id, slices,
+                window=window, on_slice=make_on_slice(nid))
+
+        task = asyncio.create_task(issue(primary_id))
+        btask: asyncio.Task | None = None
+
+        async def reap_on_cancel() -> None:
+            # our caller was cancelled: the trains must die with it —
+            # shield/asyncio.wait leave their tasks running detached
+            # otherwise, and an unretrieved RpcError would log
+            # 'exception was never retrieved' at GC
+            task.cancel()
+            if btask is not None:
+                btask.cancel()
+            await asyncio.gather(task,
+                                 *([btask] if btask is not None
+                                   else []),
+                                 return_exceptions=True)
+
+        delay = hedge.delay_s(
+            self.obs.rpc_client.recent_best_mean("store_chunks"))
+        try:
+            peak = await asyncio.wait_for(asyncio.shield(task), delay)
+            self.ingest_stalls.peak("sliceInflight", peak)
+            return
+        # absence-as-result: the timeout IS the hedge trigger — the
+        # shielded primary keeps running and is raced below
+        except asyncio.TimeoutError:  # dfslint: ignore[DFS007]
+            pass                        # primary still in flight: hedge
+        except asyncio.CancelledError:
+            await reap_on_cancel()
+            raise
+        except BaseException:
+            raise                       # primary failed fast — the
+            # caller's RpcUnreachable/RpcError handling applies as-is
+        if not hedge.take():
+            try:
+                peak = await task
+            except asyncio.CancelledError:
+                await reap_on_cancel()   # awaiting a Task does not
+                raise                    # cancel it — reap explicitly
+            self.ingest_stalls.peak("sliceInflight", peak)
+            return
+        hedge.note_fired()
+        self.obs.event("hedge_fired", op="store_chunks",
+                       primary=primary_id, backup=backup_id,
+                       slices=len(slices), delayS=round(delay, 4))
+        btask = asyncio.create_task(issue(backup_id))
+        try:
+            done, _ = await asyncio.wait(
+                {task, btask}, return_when=asyncio.FIRST_COMPLETED)
+        except asyncio.CancelledError:
+            await reap_on_cancel()
+            raise
+        first, other = (task, btask) if task in done else (btask, task)
+        first_id, other_id = (primary_id, backup_id) if first is task \
+            else (backup_id, primary_id)
+        ferr = first.exception()
+        if ferr is None:
+            other.cancel()
+            try:
+                await other
+            except (asyncio.CancelledError, RpcError, WireError):  # dfslint: ignore[DFS007]
+                pass    # reaped: the winner's train already landed
+            if not other.cancelled() \
+                    and isinstance(other.exception(), RpcUnreachable):
+                self.health.mark_dead(other_id)
+            if first_id == backup_id:
+                hedge.note_won()
+                self.obs.event("hedge_won", op="store_chunks",
+                               primary=primary_id, backup=backup_id)
+            else:
+                self.ingest_stalls.peak("sliceInflight", first.result())
+            return
+        # first train failed: fall to the other side — no third train
+        if isinstance(ferr, RpcUnreachable):
+            self.health.mark_dead(first_id)
+        try:
+            await other
+        except asyncio.CancelledError:
+            await reap_on_cancel()       # the train must die with us
+            raise
+        except (RpcError, WireError) as e:
+            # both failed: surface the PRIMARY's failure class so the
+            # caller's diagnosis targets the peer it actually chose
+            raise (ferr if first_id == primary_id else e) from None
+        if other_id == backup_id:
+            hedge.note_won()
+            self.obs.event("hedge_won", op="store_chunks",
+                           primary=primary_id, backup=backup_id)
+
     def _new_trust_ledger(self) -> _TrustLedger | None:
         """A trust ledger when the filter plane is on, else None (the
         pre-index placement path, probe per batch per peer)."""
@@ -2012,6 +2391,8 @@ class StorageNodeServer:
                     # the peer is sick), so no FP count/override
                     if isinstance(e, RpcUnreachable):
                         self.health.mark_dead(node_id)
+                        if plane.echo_cache is not None:
+                            plane.echo_cache.drop(node_id)
                     self.counters.inc("index_verify_failures")
                     for d in digests:
                         stats["dedupSkippedBytes"] -= entries[d]
@@ -2023,6 +2404,11 @@ class StorageNodeServer:
                         plane.peer_filters.note_fp(node_id, d)
                         stats["dedupSkippedBytes"] -= entries[d]
                         unconfirmed.setdefault(d, entries[d])
+                    elif plane.echo_cache is not None:
+                        # the verify round is first-party evidence too:
+                        # future re-uploads this session skip straight
+                        # past both the probe and the verify
+                        plane.echo_cache.confirm(node_id, d)
         if not unconfirmed:
             return
         # heal pre-ack: re-fetch the bytes (local CAS first — this node

@@ -312,7 +312,7 @@ def check_digest_boundary(project: Project) -> Iterator[Finding]:
 # deployment to the default — the drift this rule exists to catch)
 _CLI_CLASSES = ("NodeConfig", "ServeConfig", "IngestConfig", "ObsConfig",
                 "FragmenterConfig", "CensusConfig", "DurabilityConfig",
-                "ChaosConfig", "RingConfig", "IndexConfig")
+                "ChaosConfig", "RingConfig", "IndexConfig", "ClientConfig")
 # config field -> /metrics key that surfaces it, per stats function.
 # "cas" carries cas_io_threads as its nested workers count
 # (store/aio.py stats()).
@@ -389,7 +389,20 @@ _INDEX_METRIC_KEYS = {"enabled": "enabled",
                       "memtable_entries": "memtableEntries",
                       "compact_runs": "compactRuns",
                       "filter_bits_per_key": "filterBitsPerKey",
-                      "filter_sync_s": "filterSyncS"}
+                      "filter_sync_s": "filterSyncS",
+                      "background_compact": "backgroundCompact",
+                      "echo_cache_entries": "echoCacheEntries"}
+
+# smart-client knobs surface in SmartClient.stats()
+# (dfs_tpu/client/smart.py) — the SDK's config echo plays the same
+# role /metrics plays for server-side config
+_CLIENT_METRIC_KEYS = {"window": "window", "stripe": "stripe",
+                       "hedge_budget_per_s": "hedgeBudgetPerS",
+                       "hedge_floor_s": "hedgeFloorS",
+                       "hedge_cap_s": "hedgeCapS",
+                       "filter_max_age_s": "filterMaxAgeS",
+                       "echo_cache_entries": "echoCacheEntries",
+                       "fallback": "fallback"}
 
 
 def _dataclass_fields(src: SourceFile) -> dict[str, dict[str, int]]:
@@ -493,6 +506,7 @@ def check_config_drift(project: Project) -> Iterator[Finding]:
     serve_pkg = project.find("dfs_tpu/serve/__init__.py")
     obs_pkg = project.find("dfs_tpu/obs/__init__.py")
     chaos_pkg = project.find("dfs_tpu/chaos/__init__.py")
+    client_pkg = project.find("dfs_tpu/client/smart.py")
     classes = _dataclass_fields(cfg) if cfg and cfg.tree else {}
 
     # (1) every config field is wired through the serve CLI's
@@ -554,7 +568,9 @@ def check_config_drift(project: Project) -> Iterator[Finding]:
             (chaos_pkg, "stats", "ChaosConfig", _CHAOS_METRIC_KEYS),
             (runtime, "ring_stats", "RingConfig", _RING_METRIC_KEYS),
             (runtime, "index_stats", "IndexConfig",
-             _INDEX_METRIC_KEYS)):
+             _INDEX_METRIC_KEYS),
+            (client_pkg, "stats", "ClientConfig",
+             _CLIENT_METRIC_KEYS)):
         if src is None or src.tree is None or cls not in classes:
             continue
         keys = _stats_dict_keys(src, func)

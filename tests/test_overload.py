@@ -523,6 +523,9 @@ def test_dispatch_drops_expired_and_wire_carries_remaining(tmp_path):
             assert resp["ok"] is False
             assert "deadline" in resp["error"]
             assert n2.counters.snapshot()["deadline_drops"] >= 1
+            # the journal writes on its own thread; wait for the emit
+            # to reach disk before reading the file back
+            await asyncio.to_thread(n2.obs.journal.flush)
             tail = await asyncio.to_thread(n2.obs.journal.tail, 0.0,
                                            256)
             assert any(e.get("type") == "deadline_shed"
@@ -642,6 +645,7 @@ def test_hedged_read_beats_slow_replica(tmp_path):
             # (~55 ms observed vs 250+ ms unhedged); 0.2 s keeps the
             # assertion robust on a loaded host
             assert min(lats) < 0.2, lats
+            await asyncio.to_thread(nodes[2].obs.journal.flush)
             tail = await asyncio.to_thread(nodes[2].obs.journal.tail,
                                            0.0, 512)
             kinds = {e.get("type") for e in tail["events"]}

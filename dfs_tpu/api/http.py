@@ -422,6 +422,9 @@ async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
         snap["index"] = node.index_stats()  # dedup/index plane: LSI
         # gauges + filter bytes + probe-skip counters (r16, additive);
         # {"enabled": false, ...config echo} on a plane-less node
+        snap["tier"] = node.tier_stats()  # hot/cold tiering: ledger +
+        # demotion/promotion counters (r20, additive);
+        # {"enabled": false} on a tier-less node
         return as_json(200, snap)
 
     if method == "GET" and path == "/metrics/history":
@@ -507,6 +510,22 @@ async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
         except (ValueError, TypeError, AttributeError,
                 UnicodeDecodeError) as e:
             return plain(400, f"Bad chaos knobs: {e}")
+
+    if path == "/tier" and method in ("GET", "POST"):
+        # hot/cold tiering control plane (docs/tiering.md): GET = the
+        # /metrics "tier" section standalone; POST (empty body) = run
+        # one demotion scan NOW and answer its summary — the
+        # deterministic path tests and operators use instead of waiting
+        # out --tier-scan-interval. 404 when the plane is off: tiering
+        # is a boot decision, like /chaos.
+        if node.tier is None:
+            return plain(404, "Tiering disabled (boot with --tier)")
+        if method == "GET":
+            return as_json(200, node.tier_stats())
+        try:
+            return as_json(200, await node.tier_scan_once())
+        except ShedError as e:
+            return _shed(node, e)
 
     if path == "/ring" and method in ("GET", "POST"):
         # elastic membership admin plane (docs/membership.md): GET =

@@ -51,11 +51,14 @@ import time
 
 from dfs_tpu.config import ChaosConfig
 
-# Registered crash points: the named moments in the upload path where a
-# configured injector kills the process with SIGKILL (kill -9 grade —
-# no finally blocks, no flushes; exactly what fsync-before-ack must
-# survive). bench_chaos.py and tests/test_chaos.py iterate this
-# registry, so a new crash site must be added HERE to be exercised.
+# Registered crash points: the named moments in the write/demotion
+# paths where a configured injector kills the process with SIGKILL
+# (kill -9 grade — no finally blocks, no flushes; exactly what
+# fsync-before-ack must survive). bench_chaos.py and tests/test_chaos.py
+# iterate this registry, so a new crash site must be added HERE to be
+# exercised; ``place.*``/``upload.*`` points fire on a default-config
+# upload, ``demote.*`` points fire only during a tiering demotion
+# (exercised by tests/test_tiering.py).
 CRASH_POINTS = frozenset({
     # _place_batch: before any local CAS put of the batch
     "place.before_local_put",
@@ -67,6 +70,17 @@ CRASH_POINTS = frozenset({
     # _finalize_upload: manifest written (upload is durable), before
     # the announce fan-out / HTTP ack
     "upload.after_manifest",
+    # _demote_file: parity durable at its stripe holders, the cold
+    # manifest NOT yet written — the file must stay readable replicated
+    "demote.after_parity_write",
+    # _demote_file: cold manifest committed + index tier bit flipped,
+    # surplus replicas NOT yet deleted — readable either way, surplus
+    # reclaimed by the next scan's finish pass
+    "demote.after_tier_flip",
+    # _demote_file: immediately before the surplus-replica deletes of
+    # an already-cold file — the torn window where only SOME deletes
+    # landed; every remaining read must reconstruct from the stripe
+    "demote.before_replica_delete",
 })
 
 # knobs POST /chaos may change at runtime (everything except the

@@ -21,7 +21,7 @@ from dfs_tpu.config import (CDCParams, CensusConfig, ChaosConfig,
                             ClusterConfig, DurabilityConfig,
                             FragmenterConfig, IndexConfig, IngestConfig,
                             NodeConfig, ObsConfig, RingConfig,
-                            ServeConfig)
+                            ServeConfig, TierConfig)
 
 
 def _client(args) -> NodeClient:
@@ -120,6 +120,16 @@ def cmd_serve(args) -> int:
             filter_sync_s=args.index_filter_sync,
             background_compact=args.index_background_compact,
             echo_cache_entries=args.index_echo_cache),
+        tier=TierConfig(
+            enabled=args.tier,
+            hot_fraction=args.tier_hot_fraction,
+            min_idle_s=args.tier_min_idle,
+            scan_interval_s=args.tier_scan_interval,
+            ec_k=args.tier_ec_k,
+            demote_credit_bytes=args.tier_demote_credit_bytes,
+            half_life_s=args.tier_half_life,
+            promote_reads=args.tier_promote_reads,
+            ledger_entries=args.tier_ledger_entries),
         chaos=ChaosConfig(
             enabled=args.chaos,
             seed=args.chaos_seed,
@@ -720,6 +730,43 @@ def build_parser() -> argparse.ArgumentParser:
                             "entries (0 = off): a digest whose hash-echo "
                             "was confirmed this ring epoch skips even "
                             "the trust-verification probe on re-upload")
+    serve.add_argument("--tier", action="store_true",
+                       help="enable the hot/cold tiering plane "
+                            "(docs/tiering.md): temperature-driven "
+                            "demotion of cold files from full "
+                            "replication to EC stripes, with "
+                            "transparent reads and read-driven "
+                            "promotion")
+    serve.add_argument("--tier-hot-fraction", type=float, default=0.1,
+                       help="fraction of referenced bytes kept fully "
+                            "replicated (the hot byte budget); files "
+                            "past the temperature knee are "
+                            "cold-eligible")
+    serve.add_argument("--tier-min-idle", type=float, default=300.0,
+                       help="seconds a file must go unread before it "
+                            "may be demoted, however cold it ranks")
+    serve.add_argument("--tier-scan-interval", type=float, default=0.0,
+                       help="demotion scan cadence (s); 0 = manual "
+                            "scans only (POST /tier)")
+    serve.add_argument("--tier-ec-k", type=int, default=4,
+                       help="data chunks per parity stripe for demoted "
+                            "files (storage overhead ~(k+2)/k; needs "
+                            "k+2 ring members)")
+    serve.add_argument("--tier-demote-credit-bytes", type=int,
+                       default=8 * 1024 * 1024,
+                       help="demotion/promotion byte budget per second "
+                            "(0 = unmetered) — background tiering must "
+                            "not starve user traffic")
+    serve.add_argument("--tier-half-life", type=float, default=3600.0,
+                       help="read-heat half-life (s): each read adds "
+                            "1.0 and the sum halves every half-life")
+    serve.add_argument("--tier-promote-reads", type=float, default=2.0,
+                       help="decayed heat at which a cold file "
+                            "re-materializes replicated")
+    serve.add_argument("--tier-ledger-entries", type=int, default=65536,
+                       help="bounded temperature-ledger size (stalest "
+                            "digests evict first — eviction reads as "
+                            "cold)")
     serve.add_argument("--chaos", action="store_true",
                        help="enable the fault-injection plane "
                             "(docs/chaos.md): the knobs below apply "

@@ -119,6 +119,9 @@ OP_SPECS = {
                                                       "mtime"],
                      "body": None},
     "delete": {"request": ["fileId"], "reply": [], "body": None},
+    "delete_chunks": {"request": ["digests"],
+                      "reply": ["removed", "refused"],
+                      "body": None},
     "tombstones": {"request": [], "reply": ["tombs"], "body": None},
     "list_manifests": {"request": [], "reply": ["ids"], "body": None},
     "health": {"request": [], "reply": ["nodeId", "chunks", "files"],

@@ -532,6 +532,73 @@ class IndexConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TierConfig:
+    """Hot/cold tiering plane (dfs_tpu.tier, docs/tiering.md):
+    temperature-driven demotion of cold files from full replication to
+    wide EC stripes, with transparent promotion on re-heat.
+
+    EVERYTHING defaults off: ``TierConfig()`` builds no ledger, no
+    worker and no admission class — reads and repair run byte-identical
+    code paths to a pre-tier build (the chaos/serve/index default-off
+    discipline, asserted by tests/test_tiering.py). ``enabled=True``
+    builds the :class:`~dfs_tpu.tier.TierPlane`:
+
+    - every served chunk feeds a bounded per-digest temperature ledger
+      (last access + read count decayed with ``half_life_s``);
+    - classification is by BYTE-BUDGET percentile, not fixed age: the
+      hottest files up to ``hot_fraction`` of referenced bytes stay
+      replicated, the rest are demotion candidates once idle longer
+      than ``min_idle_s``;
+    - the demotion worker (every ``scan_interval_s``; 0 = manual
+      ``POST /tier`` scans only) EC-encodes cold files at ``ec_k``+2,
+      flips the manifest/index tier bit, then deletes surplus
+      replicas — throttled by ``demote_credit_bytes``/s so demotion
+      never starves user traffic;
+    - a cold read reconstructs transparently (the existing EC decode
+      path) and re-materializes a replicated copy once its decayed
+      read count crosses ``promote_reads``.
+    """
+
+    enabled: bool = False
+    hot_fraction: float = 0.1       # fraction of referenced bytes kept
+                                    # replicated (the hot set); the
+                                    # rest is cold-eligible
+    min_idle_s: float = 300.0       # never demote a file read more
+                                    # recently than this (absolute
+                                    # floor under the percentile)
+    scan_interval_s: float = 0.0    # demotion scan cadence (s);
+                                    # 0 = manual scans only (POST /tier)
+    ec_k: int = 4                   # data shards per cold EC stripe
+                                    # (parity is always P+Q = 2)
+    demote_credit_bytes: int = 8 * 1024 * 1024  # demotion byte budget
+                                    # per second (ByteRate, the r14
+                                    # rebalance discipline); 0 = unthrottled
+    half_life_s: float = 3600.0     # read-count decay half-life (s)
+    promote_reads: float = 2.0      # decayed reads at which a cold
+                                    # file re-materializes replicated
+    ledger_entries: int = 65536     # bounded temperature-ledger size
+                                    # (LRU beyond it)
+
+    def __post_init__(self) -> None:
+        if not 0.0 <= self.hot_fraction <= 1.0:
+            raise ValueError("hot_fraction must be within [0, 1]")
+        if self.min_idle_s < 0:
+            raise ValueError("min_idle_s must be >= 0")
+        if self.scan_interval_s < 0:
+            raise ValueError("scan_interval_s must be >= 0")
+        if not 1 <= self.ec_k <= 255:
+            raise ValueError("ec_k must be within [1, 255]")
+        if self.demote_credit_bytes < 0:
+            raise ValueError("demote_credit_bytes must be >= 0")
+        if self.half_life_s <= 0:
+            raise ValueError("half_life_s must be > 0")
+        if self.promote_reads < 0:
+            raise ValueError("promote_reads must be >= 0")
+        if self.ledger_entries < 256:
+            raise ValueError("ledger_entries must be >= 256")
+
+
+@dataclasses.dataclass(frozen=True)
 class ClientConfig:
     """Smart-client data plane (dfs_tpu.client, docs/client.md).
 
@@ -680,6 +747,10 @@ class NodeConfig:
     # builds NO index and NO filters — local existence stays one stat,
     # placement probes every digest over RPC (pre-r16 paths exactly)
     index: IndexConfig = dataclasses.field(default_factory=IndexConfig)
+    # hot/cold tiering plane (dfs_tpu.tier): the default TierConfig()
+    # builds NO ledger and NO worker — reads, repair and census run
+    # byte-identical code paths to a pre-tier build
+    tier: TierConfig = dataclasses.field(default_factory=TierConfig)
 
     @property
     def self_addr(self) -> PeerAddr:

@@ -141,6 +141,12 @@ class IndexPlane:
         # blooms cannot unlearn: the delete stays a stale bit until the
         # next compaction rebuilds the filter (fresh generation)
 
+    def note_tier(self, digest: str, cold: bool) -> None:
+        """Tier flip (r20): presence is unchanged — the digest stays in
+        the local filter either way — only the LSI state byte moves
+        between hot and cold."""
+        self.lsi.note_tier(digest, cold)
+
     def maybe_flush(self) -> None:
         """Deferred flush/compaction check (see DigestIndex.note_put):
         the ChunkStore seam calls this AFTER releasing its ordering

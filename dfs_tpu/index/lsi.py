@@ -73,6 +73,10 @@ FENCE_EVERY = 1024                 # records per fenced block
 
 _PRESENT = 1
 _DELETED = 0
+_PRESENT_COLD = 2   # present, demoted to the EC cold tier (r20) — a
+                    # presence verdict for every reader (lookup /
+                    # filters / compaction keep the record), distinct
+                    # only for the tiering plane's bookkeeping
 
 
 class _Run:
@@ -425,6 +429,17 @@ class DigestIndex:
         self._note(digest, _DELETED, wal_flush=True,
                    defer_flush=defer_flush)
 
+    def note_tier(self, digest: str, cold: bool) -> None:
+        """Record a tier flip (r20). Written through like a delete:
+        the tier bit is flipped UNDER the demotion barrier (parity
+        durable, replicas not yet dropped), so losing the record would
+        leave the next life re-demoting an already-cold file — safe
+        but wasteful; the write-through makes it merely unlikely. The
+        WAL/run record format already round-trips arbitrary state
+        bytes, so cold survives replay and compaction for free."""
+        self._note(digest, _PRESENT_COLD if cold else _PRESENT,
+                   wal_flush=True, defer_flush=False)
+
     def _note(self, digest: str, state: int, wal_flush: bool,
               defer_flush: bool) -> None:
         raw = bytes.fromhex(digest)
@@ -603,7 +618,7 @@ class DigestIndex:
             for run in snapshot:
                 merged.update(run.records())
             recs = sorted((d, s) for d, s in merged.items()
-                          if s == _PRESENT)
+                          if s != _DELETED)
             new_run = self._write_run_file(recs, seq)
             if self.hook is not None:
                 self.hook("index.compact")
@@ -658,7 +673,7 @@ class DigestIndex:
         with self._lock:
             state = self._memtable.get(raw)
             if state is not None:
-                return state == _PRESENT
+                return state != _DELETED
             runs = list(reversed(self._runs))   # newest first
             for r in runs:
                 r.refs += 1
@@ -666,7 +681,7 @@ class DigestIndex:
             for run in runs:
                 state = run.get(raw, prefix)
                 if state is not None:
-                    return state == _PRESENT
+                    return state != _DELETED
             return False
         finally:
             with self._lock:
@@ -687,7 +702,7 @@ class DigestIndex:
             for run in self._runs:
                 merged.update(run.records())
             merged.update(self._memtable)
-        return [d for d, s in merged.items() if s == _PRESENT]
+        return [d for d, s in merged.items() if s != _DELETED]
 
     # ---------------------------------------------------------------- #
     # lifecycle / stats

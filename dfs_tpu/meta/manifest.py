@@ -86,6 +86,11 @@ class Manifest:
     fragmenter: str               # "fixed" | "cdc" | "cdc-tpu"
     chunks: tuple[ChunkRef, ...]
     ec: EcInfo | None = None
+    tier: str | None = None       # "cold" = demoted to EC cold storage
+                                  # (r20); None = replicated hot tier.
+                                  # The manifest carries the CLUSTER
+                                  # truth — the per-node index tier bit
+                                  # is a best-effort mirror.
 
     def __post_init__(self) -> None:
         covered = 0
@@ -153,6 +158,10 @@ class Manifest:
                          "stripes": [{"p": s.p, "q": s.q,
                                       "shard_len": s.shard_len}
                                      for s in self.ec.stripes]}
+        if self.tier is not None:
+            # emitted only when set: an untiered manifest serializes
+            # byte-identically to a pre-r20 build
+            doc["tier"] = self.tier
         return json.dumps(doc, indent=None, separators=(",", ":"))
 
     @staticmethod
@@ -170,4 +179,5 @@ class Manifest:
             fragmenter=d.get("fragmenter", "fixed"),
             chunks=tuple(ChunkRef(**c) for c in d["chunks"]),
             ec=ec,
+            tier=d.get("tier"),
         )

@@ -379,6 +379,23 @@ class StorageNodeServer:
         # admission gates + readahead. Default config = every component
         # off, and the node runs the historical code paths exactly.
         self.serve = ServingTier(cfg.serve, obs=self.obs)
+        # hot/cold tiering plane (dfs_tpu.tier, docs/tiering.md): None
+        # unless TierConfig.enabled — the default node never touches a
+        # ledger, never scans, and serves byte-identical paths. Built
+        # after serve (the read path feeds the ledger) and after ring
+        # (demotion reuses ring-walk EC stripe placement).
+        self.tier = None
+        self._tier_task: asyncio.Task | None = None
+        self._tier_promoting: set[str] = set()  # file ids mid-promotion
+        # cold files whose surplus replicas are CONFIRMED reclaimed,
+        # keyed to the ring epoch the confirmation was computed under
+        # (an epoch bump moves ownership — re-judge)
+        self._tier_surplus_done: dict[str, int] = {}
+        if cfg.tier.enabled:
+            from dfs_tpu.tier import TierPlane
+
+            self.tier = TierPlane(cfg.tier, self.store.root / "tier",
+                                  obs=self.obs)
         # census/capacity plane (docs/observability.md): the embedded
         # metrics-history ring a background sampler feeds — trend data
         # for GET /metrics/history and the doctor's capacity_trend
@@ -453,6 +470,12 @@ class StorageNodeServer:
         if self.history is not None:
             self._history_task = create_logged_task(
                 self._history_loop(), self.log, "census-history")
+        if self.tier is not None and self.cfg.tier.scan_interval_s > 0:
+            # demotion worker: started HERE (not a CLI periodic) so
+            # in-process test nodes run it too; scan_interval_s == 0
+            # leaves scans manual (POST /tier) for determinism
+            self._tier_task = create_logged_task(
+                self._tier_loop(), self.log, "tier-scan")
         if self._peers():
             # membership catch-up: a (re)started node may have slept
             # through epoch bumps (or lost its ring.json) — one cheap
@@ -487,6 +510,14 @@ class StorageNodeServer:
         if self._filter_sync_task is not None:
             self._filter_sync_task.cancel()
             self._filter_sync_task = None
+        if self._tier_task is not None:
+            self._tier_task.cancel()
+            self._tier_task = None
+        if self.tier is not None:
+            # parting ledger snapshot (atomic write, off the loop) —
+            # best-effort: losing it only under-counts heat
+            with contextlib.suppress(OSError):
+                await asyncio.to_thread(self.tier.snapshot_ledger)
         if self.sentinel is not None:
             self.sentinel.stop()
         self.health.stop()
@@ -591,7 +622,16 @@ class StorageNodeServer:
             if weights.get(node_id, 0) > 0:
                 raise ValueError(f"node {node_id} is already a ring "
                                  "member")
-            weights[node_id] = 1.0 if weight is None else float(weight)
+            if weight is None:
+                # capacity-derived default (r20): weight the joiner by
+                # its disk headroom relative to the median member, so
+                # `ring add` without an explicit --weight stops
+                # assigning a small disk the same share as a big one.
+                # An explicit weight always wins; any probe failure
+                # falls back to the old 1.0.
+                weight = await self._derive_add_weight(
+                    node_id, [m for m, w in weights.items() if w > 0])
+            weights[node_id] = float(weight)
         elif action == "drain":
             if node_id is None or node_id not in weights:
                 raise ValueError(f"node {node_id} is not a ring member")
@@ -632,6 +672,50 @@ class StorageNodeServer:
             *(push(p) for p in self._peers())))
         return {"action": action, "epoch": new.epoch,
                 "ring": ring_dict, "pushed": pushed}
+
+    _ADD_WEIGHT_MIN = 0.25    # capacity-derived weight clamp: a tiny
+    _ADD_WEIGHT_MAX = 4.0     # disk still takes SOME share, a huge one
+                              # never dominates the map on day one
+
+    async def _derive_add_weight(self, node_id: int,
+                                 members: list[int]) -> float:
+        """Default weight for ``ring add`` (r20): the joiner's free
+        disk bytes over the MEDIAN active member's, clamped to
+        [0.25, 4.0]. Headroom comes from the census inventory's
+        ``disk`` block (the ``df`` numbers) — self via the local
+        statvfs, peers via one ``get_census`` round. Any failure —
+        unreachable joiner, no members answering, zero medians —
+        falls back to 1.0, the pre-r20 constant."""
+        async def free_bytes(nid: int) -> float | None:
+            try:
+                if nid == self.cfg.node_id:
+                    disk = await asyncio.to_thread(self._disk_usage)
+                else:
+                    resp, _ = await self.client.call(
+                        self.cfg.cluster.peer(nid),
+                        {"op": "get_census"}, retries=1)
+                    disk = (resp.get("census") or {}).get("disk") or {}
+                free = disk.get("freeBytes")
+                return float(free) if isinstance(free, (int, float)) \
+                    and free > 0 else None
+            # not silent: a None row degrades to the 1.0 fallback below
+            except (RpcError, KeyError):  # dfslint: ignore[DFS007]
+                return None
+
+        target = await free_bytes(node_id)
+        if target is None:
+            return 1.0
+        frees = [f for f in await asyncio.gather(
+            *(free_bytes(m) for m in members)) if f is not None]
+        if not frees:
+            return 1.0
+        frees.sort()
+        median = frees[len(frees) // 2]
+        if median <= 0:
+            return 1.0
+        w = max(self._ADD_WEIGHT_MIN,
+                min(self._ADD_WEIGHT_MAX, target / median))
+        return round(w, 3)
 
     async def ring_status(self, cluster: bool = True) -> dict:
         """GET /ring: this node's membership view plus (cluster=True)
@@ -1049,6 +1133,12 @@ class StorageNodeServer:
             # off-loop via the bounded CAS pool: a cold read under
             # writeback pressure is a multi-ms (worst observed: multi-s)
             # syscall the serving loop must not eat inline
+            if self.tier is not None:
+                # storage-plane temperature feed (docs/tiering.md): a
+                # holder serving a chunk to a peer's download IS read
+                # demand — without this only the coordinating node's
+                # ledger heats and every other scanner misclassifies
+                self.tier.ledger.note_read(header["digest"])
             data = await self.cas.get(header["digest"])
             if data is None:
                 return {"ok": False, "error": "chunk not found"}, b""
@@ -1061,6 +1151,11 @@ class StorageNodeServer:
             # Reads ride the bounded CAS pool like every other chunk-file
             # touch — a burst of peer batched fetches must not stack
             # unbounded executor jobs.
+            if self.tier is not None:
+                # same storage-plane temperature feed as get_chunk
+                for d in header.get("digests", []):
+                    if isinstance(d, str):
+                        self.tier.ledger.note_read(d)
             have = await self.cas.get_many(header.get("digests", []))
             table, bufs = pack_chunks(have)
             # buffer list straight from CAS reads to the socket — the
@@ -1077,6 +1172,42 @@ class StorageNodeServer:
             # default durability mode) + the delete-triggered GC sweep
             await asyncio.to_thread(self._forget_file, header["fileId"])
             return {"ok": True}, b""
+        if op == "delete_chunks":
+            # surplus-replica reclaim (r20 tiering): the demoting node
+            # asks peers to drop chunk copies that the COLD manifest no
+            # longer places on them. The receiver NEVER trusts the
+            # caller's view — it re-derives its own expected set from
+            # its own manifests + ring and refuses any digest it still
+            # believes it owns. A stale peer (missed the demote
+            # announce) therefore refuses — the safe direction; the
+            # caller re-announces and retries on a later scan. Refused
+            # wholesale mid-migration: the dual-read window may need
+            # any replica.
+            digests = header.get("digests", [])
+            if not (isinstance(digests, list) and
+                    all(isinstance(d, str) and len(d) == 64
+                        for d in digests)):
+                return {"ok": False, "error": "bad digests"}, b""
+            if self.ring.migrating:
+                return {"ok": True, "removed": [],
+                        "refused": list(digests)}, b""
+
+            def reclaim():
+                expected = self._expected_digests_here(set(digests))
+                removed, refused = [], []
+                for d in digests:
+                    if d in expected:
+                        refused.append(d)
+                    elif self.store.chunks.delete(d):
+                        removed.append(d)
+                return removed, refused
+
+            removed, refused = await asyncio.to_thread(reclaim)
+            self.serve.drop_cached(removed)
+            if removed:
+                self.counters.inc("tier_chunks_reclaimed", len(removed))
+            return {"ok": True, "removed": removed,
+                    "refused": refused}, b""
         if op == "get_trace":
             # span query for cross-node stitching (trace_spans below):
             # cheap metadata (bounded ring scan), ungated like health
@@ -1196,6 +1327,18 @@ class StorageNodeServer:
         device encode when the node's fragmenter already runs on one) and
         return the EC manifest plus the parity (digest, payload) list.
         Runs in a worker thread — NumPy/encode work."""
+        view = memoryview(data)
+        src = {c.digest: view[c.offset:c.offset + c.length]
+               for c in manifest.chunks}
+        return self._ec_extend_from(manifest, src, k)
+
+    def _ec_extend_from(self, manifest: Manifest,
+                        chunk_bytes: Mapping[str, bytes], k: int
+                        ) -> tuple[Manifest, list[tuple[str, bytes]]]:
+        """:meth:`_ec_extend` with per-chunk payloads sourced from a
+        digest map instead of one contiguous buffer — the shape tier
+        demotion has (its bytes come from a ``_gather_chunks`` dict,
+        never a whole-file assembly). Worker-thread code."""
         import dataclasses as _dc
 
         import numpy as np
@@ -1205,13 +1348,13 @@ class StorageNodeServer:
         device = "tpu" in self.fragmenter.name
         stripes: list[StripeRef] = []
         parity: list[tuple[str, bytes]] = []
-        view = memoryview(data)
         for grp in ec_stripe_groups(manifest.chunks, k):
             pad = stripe_shard_len(grp)
             sh = np.zeros((len(grp), pad), dtype=np.uint8)
             for j, c in enumerate(grp):
                 sh[j, :c.length] = np.frombuffer(
-                    view[c.offset:c.offset + c.length], dtype=np.uint8)
+                    chunk_bytes[c.digest], dtype=np.uint8,
+                    count=c.length)
             p, q = ec_ops.encode_pq(sh, device=device)
             pb, qb = p.tobytes(), q.tobytes()
             pd, qd = sha256_hex(pb), sha256_hex(qb)
@@ -3312,6 +3455,13 @@ class StorageNodeServer:
             self.counters.inc("deadline_drops")
             self.obs.event("deadline_shed", where="fetch")
             raise DeadlineExceeded("deadline expired")
+        if self.tier is not None:
+            # temperature feed (docs/tiering.md): every requested digest
+            # counts as one read — BEFORE the cache/flight split, so
+            # cache hits and misses heat the ledger alike (temperature
+            # is about demand, not about where the bytes came from)
+            for c in chunks:
+                self.tier.ledger.note_read(c.digest)
         serve = self.serve
         if not serve.read_path_enabled:
             return await self._fetch_verified_direct(manifest, chunks,
@@ -3435,6 +3585,10 @@ class StorageNodeServer:
         silently completed. The first batch is fetched eagerly so
         unrecoverable-chunk failures surface before any byte is sent."""
         manifest = await self._resolve_manifest(file_id)
+        # promotion trigger (docs/tiering.md): a cold file read hot
+        # enough re-materializes replicated in the BACKGROUND — this
+        # read itself reconstructs transparently via the EC decode path
+        self._tier_maybe_promote(manifest)
         refs = list(manifest.chunks)
         batches: list[list] = []
         cur: list = []
@@ -3667,6 +3821,16 @@ class StorageNodeServer:
                         self.ring.rebalance_stats().items()
                         if k in ("sinceProgressS", "bytesMoved",
                                  "dualReadHits")}},
+            # tiering plane view: scan cadence + progress gauge — the
+            # doctor's tier_stall evidence (a worker that stopped
+            # completing scans leaves the cold tail undemoted silently)
+            "tier": {"enabled": False} if self.tier is None else {
+                "enabled": True,
+                "scanIntervalS": self.cfg.tier.scan_interval_s,
+                "sinceProgressS": round(
+                    time.monotonic() - self.tier.last_progress_at, 3),
+                "errors": self.tier.errors,
+                "scans": self.tier.scans},
         }
 
     async def doctor_report(self, cluster: bool = True) -> dict:
@@ -4553,4 +4717,455 @@ class StorageNodeServer:
             self.counters.inc("scrub_corrupt", corrupt)
             self.obs.event("scrub_corrupt", scanned=scanned,
                            corrupt=corrupt)
-        return {"scanned": scanned, "corrupt": corrupt}
+        out = {"scanned": scanned, "corrupt": corrupt}
+        if self.index is not None:
+            healed = await asyncio.to_thread(
+                self._scrub_index_heal, digests)
+            out.update(healed)
+        return out
+
+    def _scrub_index_heal(self, cas_digests: list[str]) -> dict:
+        """Index-vs-walk divergence healing (r20 satellite): the scrub
+        just paid for a full CAS readdir, so diff it against the digest
+        index and repair both divergence directions — digests on disk
+        the index never heard of (lost WAL tail, crash between link and
+        note_put) become present; digests the index believes present
+        but the walk cannot find (missed delete record) are expunged.
+        Phantoms are the dangerous direction — a stale "present" makes
+        ``has_chunks`` vouch for bytes that do not exist — which is why
+        this runs every scrub, not only at the boot rebuild. Worker
+        thread: the merge pass + WAL writes are blocking."""
+        # re-list rather than trusting the scan-start snapshot for the
+        # on-disk side of PHANTOM decisions: a chunk stored mid-scrub
+        # must not be expunged as a phantom (stale-present is the
+        # direction we heal, stale-absent the index design tolerates)
+        on_disk = set(self.store.chunks.digests())
+        on_disk.update(cas_digests)
+        in_index = {d.hex() for d in self.index.lsi.present_digests()}
+        missing = on_disk - in_index       # disk has it, index doesn't
+        phantom = in_index - on_disk       # index has it, disk doesn't
+        for d in missing:
+            self.index.note_put(d)
+        for d in phantom:
+            self.index.note_delete(d)
+        if missing or phantom:
+            self.counters.inc("index_healed_missing", len(missing))
+            self.counters.inc("index_healed_phantom", len(phantom))
+            self.obs.event("index_healed", missing=len(missing),
+                           phantom=len(phantom))
+            self.log.warning(
+                "scrub: index healed (%d missing, %d phantom)",
+                len(missing), len(phantom))
+        return {"healedMissing": len(missing),
+                "healedPhantom": len(phantom)}
+
+    # ------------------------------------------------------------------ #
+    # hot/cold tiering plane (r20, dfs_tpu.tier, docs/tiering.md)
+    # ------------------------------------------------------------------ #
+
+    async def _tier_loop(self) -> None:
+        """Periodic demotion scan (started by :meth:`start` when
+        ``tier.scan_interval_s > 0``). Background work: no request
+        deadline, and a scan already in flight sheds the next tick
+        (single-slot gate) instead of stacking."""
+        deadline.clear()
+        from dfs_tpu.serve.admission import ShedError
+        while True:
+            await asyncio.sleep(self.cfg.tier.scan_interval_s)
+            try:
+                await self.tier_scan_once()
+            # silent on purpose: a manual POST /tier holds the single
+            # slot — the loop's next tick simply retries
+            except ShedError:  # dfslint: ignore[DFS007]
+                continue
+            # not silent: counted + journaled, and the loop must outlive
+            # any one bad cycle (transient peer failures mid-demotion)
+            except (RpcError, OSError, DownloadError) as e:
+                self.tier.errors += 1
+                self.obs.event("tier_error", where="scan", error=str(e))
+                self.log.warning("tier scan failed: %s", e)
+
+    async def tier_scan_once(self) -> dict:
+        """One demotion scan (POST /tier, the worker loop): classify
+        every replicated file by temperature, demote the cold tail to
+        EC, and finish any half-reclaimed earlier demotions. Raises
+        ShedError when a scan is already running (the single-slot
+        admission class — HTTP maps it to 503 Retry-After)."""
+        plane = self.tier
+        cfg = self.cfg.tier
+        deadline.clear()          # background-class work: a manual POST
+        # /tier must not ride (and die by) the request's read budget
+        async with plane.gate.slot():
+            out = {"scanned": 0, "cold": 0, "demoted": 0,
+                   "finished": 0, "skipped": None}
+            if self.ring.migrating:
+                # a rebalance in flight moves ownership under the
+                # dual-read window — demotion waits for stable ground
+                out["skipped"] = "migrating"
+                return out
+            if cfg.ec_k + 2 > len(self.ring.node_ids()):
+                out["skipped"] = "ring too small for ec stripes"
+                return out
+            now = time.time()
+            manifests = await asyncio.to_thread(self.store.manifests.list)
+            entries: list[dict] = []
+            by_id: dict[str, Manifest] = {}
+            cold_done: list[Manifest] = []
+            for m in manifests:
+                if m.tier == "cold":
+                    cold_done.append(m)
+                    continue
+                if m.ec is not None:
+                    continue      # user-chosen EC layout: not ours to move
+                heat, last = plane.ledger.file_temperature(
+                    (c.digest for c in m.chunks), now=now)
+                entries.append({"fileId": m.file_id, "bytes": m.size,
+                                "heat": heat, "lastAccess": last})
+                by_id[m.file_id] = m
+            from dfs_tpu.tier import classify
+            # the budget base counts ALREADY-COLD bytes too: the hot
+            # set is a fraction of the corpus, not of the not-yet-
+            # demoted remainder (which shrinks every scan)
+            cold = classify(entries, cfg.hot_fraction, cfg.min_idle_s,
+                            now=now,
+                            total_bytes=(sum(e["bytes"]
+                                             for e in entries)
+                                         + sum(m.size
+                                               for m in cold_done)))
+            out["scanned"] = len(entries)
+            out["cold"] = len(cold)
+            for fid in sorted(cold):
+                if fid in self._tier_promoting:
+                    continue      # racing promotion wins: it has reads
+                try:
+                    if await self._demote_file(by_id[fid]):
+                        out["demoted"] += 1
+                # not silent: per-file isolation — one unreachable
+                # replica set must not starve the rest of the scan
+                except (RpcError, OSError, DownloadError,
+                        UploadError) as e:
+                    plane.errors += 1
+                    self.obs.event("tier_error", where="demote",
+                                   fileId=fid, error=str(e))
+                    self.log.warning("tier demote %s failed: %s",
+                                     fid[:12], e)
+            # finish pass: earlier demotions whose surplus reclaim was
+            # interrupted (crash between tier flip and deletes, stale
+            # peers that refused) — idempotent, skipped once confirmed
+            # clean at this ring epoch
+            for m in cold_done:
+                if self._tier_surplus_done.get(m.file_id) \
+                        == self.ring.epoch:
+                    continue
+                try:
+                    await self._tier_delete_surplus(m)
+                    out["finished"] += 1
+                # not silent: same per-file isolation as the demote loop
+                except (RpcError, OSError) as e:
+                    plane.errors += 1
+                    self.obs.event("tier_error", where="finish",
+                                   fileId=m.file_id, error=str(e))
+            plane.scans += 1
+            plane.last_scan_at = now
+            plane.note_progress()
+            await asyncio.to_thread(plane.snapshot_ledger)
+            self.obs.event("tier_scan", scanned=out["scanned"],
+                           cold=out["cold"], demoted=out["demoted"],
+                           finished=out["finished"])
+            return out
+
+    async def _demote_file(self, m: Manifest) -> bool:
+        """Demote one cold replicated file to EC: gather its bytes,
+        encode parity, place data+parity at the stripe-derived single
+        holders, commit the cold manifest (the durable tier flip —
+        fsync-barriered like every manifest save), then reclaim the
+        surplus replicas. Ordered so a crash at ANY point leaves the
+        file readable: parity before flip (a flip without parity would
+        strip redundancy), flip before deletes (deletes only remove
+        copies the cold layout no longer expects)."""
+        import dataclasses
+
+        plane = self.tier
+        plane.note_credit_stall(await plane.credits.acquire(m.size))
+        data = await self._gather_chunks(m)
+        cold_m, parity = await asyncio.to_thread(
+            self._ec_extend_from, dataclasses.replace(m, tier="cold"),
+            data, self.cfg.tier.ec_k)
+        seen: set[str] = set()
+        batch: list[tuple[str, bytes]] = []
+        for c in m.chunks:
+            if c.digest not in seen:
+                seen.add(c.digest)
+                batch.append((c.digest, data[c.digest]))
+        for d, b in parity:
+            if d not in seen:     # k=1 makes Q == P (upload's rule)
+                seen.add(d)
+                batch.append((d, b))
+        stats = self._new_upload_stats()
+        placement = ec_placement_map(cold_m, self.ring.current)
+        await self._place_batch(m.file_id, batch, stats, rf=1,
+                                placement=placement)
+        if self.chaos is not None:
+            self.chaos.maybe_crash("demote.after_parity_write")
+        # the COMMIT: a tombstone landing mid-demotion wins — the file
+        # was deleted, so the cold layout must not resurrect it
+        if not await asyncio.to_thread(self.store.manifests.save,
+                                       cold_m):
+            return False
+        if self.index is not None:
+            def flip():
+                for d in sorted({c.digest for c in m.chunks}):
+                    self.index.note_tier(d, True)
+            await asyncio.to_thread(flip)
+        if self.chaos is not None:
+            self.chaos.maybe_crash("demote.after_tier_flip")
+        await self._announce_all(cold_m)
+        pbytes = sum(len(b) for _, b in parity)
+        plane.demoted_files += 1
+        plane.demoted_bytes += m.size
+        plane.parity_bytes += pbytes
+        plane.note_progress()
+        self.counters.inc("tier_demotions")
+        self.obs.event("tier_demote", fileId=m.file_id, bytes=m.size,
+                       parityBytes=pbytes)
+        await self._tier_delete_surplus(cold_m)
+        return True
+
+    async def _tier_delete_surplus(self, m: Manifest) -> tuple[int, int]:
+        """Reclaim replica copies the cold layout no longer expects —
+        locally via the same re-derivation peers use (a digest SHARED
+        with a hot manifest keeps its replicas), remotely via the
+        ``delete_chunks`` op, where each peer re-derives its OWN
+        expected set and refuses anything it still believes it owns.
+        ``refused > 0`` means some peer holds a stale (replicated) view
+        of this manifest — re-announce the cold manifest so the next
+        pass converges. Returns (removed, refused) across the cluster."""
+        if self.chaos is not None:
+            self.chaos.maybe_crash("demote.before_replica_delete")
+        digests = sorted({c.digest for c in m.chunks})
+        length = {c.digest: c.length for c in m.chunks}
+        plane = self.tier
+
+        def local_reclaim() -> list[str]:
+            expected = self._expected_digests_here(set(digests))
+            return [d for d in digests
+                    if d not in expected and self.store.chunks.delete(d)]
+
+        removed_local = await asyncio.to_thread(local_reclaim)
+        self.serve.drop_cached(removed_local)
+        removed = len(removed_local)
+        refused = 0
+        plane.reclaimed_bytes += sum(length[d] for d in removed_local)
+
+        async def one(peer) -> tuple[list[str], int]:
+            try:
+                resp, _ = await self.client.call(
+                    peer, {"op": "delete_chunks", "digests": digests},
+                    retries=1)
+                return (resp.get("removed") or [],
+                        len(resp.get("refused") or []))
+            # not silent: an unreachable peer counts as refused — the
+            # finish pass retries next scan
+            except RpcError:  # dfslint: ignore[DFS007]
+                return [], len(digests)
+
+        for got, ref in await asyncio.gather(
+                *(one(p) for p in self._peers())):
+            removed += len(got)
+            refused += ref
+            plane.reclaimed_bytes += sum(
+                length.get(d, 0) for d in got)
+        if refused:
+            # stale peers (missed the demote announce) refuse deletes —
+            # the safe direction; converge them and retry next scan
+            await self._announce_all(m)
+            self._tier_surplus_done.pop(m.file_id, None)
+        else:
+            self._tier_surplus_done[m.file_id] = self.ring.epoch
+        plane.note_progress()
+        return removed, refused
+
+    def _expected_digests_here(self, candidates: set[str]) -> set[str]:
+        """The subset of ``candidates`` this node is a canonical holder
+        of under its OWN manifests + ring view: EC manifests pin via the
+        stripe placement map, replicated manifests via the digest ring.
+        Worker-thread code (manifest walk). The reclaim paths delete
+        only what this never returns — first-party evidence, never the
+        caller's claim."""
+        out: set[str] = set()
+        rf = self.cfg.cluster.replication_factor
+        ring = self.ring.current
+        me = self.cfg.node_id
+        for m in self.store.manifests.list():
+            if m.ec is not None:
+                pl = ec_placement_map(m, ring)
+                for d in m.all_digests():
+                    if d in candidates and me in pl.get(d, ()):
+                        out.add(d)
+            else:
+                for c in m.chunks:
+                    if c.digest in candidates \
+                            and me in ring.owners(c.digest, rf):
+                        out.add(c.digest)
+            if len(out) == len(candidates):
+                break
+        return out
+
+    def _tier_maybe_promote(self, manifest: Manifest) -> None:
+        """Read-path promotion check (download_stream): a cold file
+        whose decayed heat crossed ``promote_reads`` re-materializes
+        replicated in the background. The triggering read itself is
+        served by the transparent EC decode — promotion is never on the
+        read's critical path."""
+        if self.tier is None or manifest.tier != "cold":
+            return
+        if manifest.file_id in self._tier_promoting:
+            return
+        heat, _ = self.tier.ledger.file_temperature(
+            c.digest for c in manifest.chunks)
+        if heat < self.cfg.tier.promote_reads:
+            return
+        self._tier_promoting.add(manifest.file_id)
+        create_logged_task(self._promote_file(manifest), self.log,
+                           "tier-promote")
+
+    async def _promote_file(self, m: Manifest) -> None:
+        """Re-materialize a hot-again cold file at full replication:
+        gather (EC decode fills any dead holder), place at the digest
+        ring's rf owners, commit the hot manifest, then reclaim the
+        now-unreferenced parity through the delete_chunks discipline.
+        Mirror-ordered to demotion: replicas before flip, flip before
+        parity deletes."""
+        import dataclasses
+
+        plane = self.tier
+        deadline.clear()          # spawned from a request's context —
+        # background re-materialization must not inherit its budget
+        try:
+            plane.note_credit_stall(await plane.credits.acquire(m.size))
+            data = await self._gather_chunks(m)
+            hot_m = dataclasses.replace(m, ec=None, tier=None)
+            seen: set[str] = set()
+            batch: list[tuple[str, bytes]] = []
+            for c in m.chunks:
+                if c.digest not in seen:
+                    seen.add(c.digest)
+                    batch.append((c.digest, data[c.digest]))
+            stats = self._new_upload_stats()
+            await self._place_batch(m.file_id, batch, stats)
+            # the COMMIT (tombstone race aborts, as in demotion)
+            if not await asyncio.to_thread(self.store.manifests.save,
+                                           hot_m):
+                return
+            if self.index is not None:
+                def flip():
+                    for d in sorted(seen):
+                        self.index.note_tier(d, False)
+                await asyncio.to_thread(flip)
+            await self._announce_all(hot_m)
+            self._tier_surplus_done.pop(m.file_id, None)
+            await self._tier_reclaim_parity(m)
+            plane.promoted_files += 1
+            plane.promoted_bytes += m.size
+            plane.note_progress()
+            self.counters.inc("tier_promotions")
+            self.obs.event("tier_promote", fileId=m.file_id,
+                           bytes=m.size)
+        # not silent: counted + journaled; the file stays cold and a
+        # later read re-triggers promotion
+        except (RpcError, OSError, DownloadError,
+                UploadError) as e:
+            plane.errors += 1
+            self.obs.event("tier_error", where="promote",
+                           fileId=m.file_id, error=str(e))
+            self.log.warning("tier promote %s failed: %s",
+                             m.file_id[:12], e)
+        finally:
+            self._tier_promoting.discard(m.file_id)
+
+    async def _tier_reclaim_parity(self, m: Manifest) -> tuple[int, int]:
+        """Delete the parity chunks a promotion orphaned — same
+        receiver-re-derives discipline as surplus reclaim (a peer whose
+        manifests still expect the parity, e.g. one that missed the
+        hot announce, refuses; the re-announce converges it)."""
+        if m.ec is None:
+            return 0, 0
+        parity = sorted({d for st in m.ec.stripes for d in (st.p, st.q)})
+
+        def local() -> int:
+            expected = self._expected_digests_here(set(parity))
+            return sum(1 for d in parity
+                       if d not in expected
+                       and self.store.chunks.delete(d))
+
+        removed = await asyncio.to_thread(local)
+        self.serve.drop_cached(parity)
+        refused = 0
+
+        async def one(peer) -> tuple[int, int]:
+            try:
+                resp, _ = await self.client.call(
+                    peer, {"op": "delete_chunks", "digests": parity},
+                    retries=1)
+                return (len(resp.get("removed") or []),
+                        len(resp.get("refused") or []))
+            # not silent: unreachable = refused; aged GC is the backstop
+            except RpcError:  # dfslint: ignore[DFS007]
+                return 0, len(parity)
+
+        for got, ref in await asyncio.gather(
+                *(one(p) for p in self._peers())):
+            removed += got
+            refused += ref
+        return removed, refused
+
+    async def _announce_all(self, manifest: Manifest) -> None:
+        """Best-effort manifest announce to every peer (the
+        _finalize_upload fan-out WITHOUT fresh=True: a tier flip must
+        bounce off tombstones, never resurrect a deleted file)."""
+        mj = manifest.to_json()
+
+        async def announce(peer) -> None:
+            try:
+                await self.client.announce(peer, mj)
+            except RpcError as e:
+                self.log.warning("announce to node %d failed: %s",
+                                 peer.node_id, e)
+                self.counters.inc("announce_failures")
+
+        await asyncio.gather(*(announce(p) for p in self._peers()))
+
+    def tier_stats(self) -> dict:
+        """``/metrics`` ``tier`` section. The enabled/hotFraction/
+        minIdleS/scanIntervalS/ecK/demoteCreditBytes/halfLifeS/
+        promoteReads/ledgerEntries keys mirror TierConfig fields
+        (dfslint DFS005 checks the config ⇄ CLI ⇄ metrics mapping);
+        the rest is live plane state. ``{"enabled": False}`` is the
+        whole story for the default tier-less node."""
+        t = self.cfg.tier
+        plane = self.tier
+        out = {"enabled": t.enabled,
+               "hotFraction": t.hot_fraction,
+               "minIdleS": t.min_idle_s,
+               "scanIntervalS": t.scan_interval_s,
+               "ecK": t.ec_k,
+               "demoteCreditBytes": t.demote_credit_bytes,
+               "halfLifeS": t.half_life_s,
+               "promoteReads": t.promote_reads,
+               "ledgerEntries": t.ledger_entries}
+        if plane is None:
+            return {"enabled": False}
+        out["ledgerSize"] = len(plane.ledger)
+        out["scans"] = plane.scans
+        out["demotedFiles"] = plane.demoted_files
+        out["demotedBytes"] = plane.demoted_bytes
+        out["parityBytes"] = plane.parity_bytes
+        out["reclaimedBytes"] = plane.reclaimed_bytes
+        out["promotedFiles"] = plane.promoted_files
+        out["promotedBytes"] = plane.promoted_bytes
+        out["errors"] = plane.errors
+        out["creditStallS"] = round(plane.credit_stall_s, 3)
+        out["sinceProgressS"] = round(
+            time.monotonic() - plane.last_progress_at, 3)
+        out["admission"] = plane.gate.stats()
+        return out

@@ -79,6 +79,15 @@ Rules (each produces ``{"rule", "severity", "peers", "evidence"}``):
                        membership summary that stopped refreshing —
                        the gossip loop is failing (see its
                        ``filter_sync_failures`` counter / journal).
+- ``tier_stall``     — a node running the tiering plane with a
+                       background scan cadence has made no tiering
+                       progress for ``TIER_STALL_FACTOR`` scan
+                       intervals (floored at ``TIER_STALL_MIN_S``):
+                       the demotion worker is wedged or every scan is
+                       erroring out — cold data silently stays at full
+                       replication cost. Manual-scan nodes
+                       (``scanIntervalS == 0``) are exempt: no cadence
+                       was promised.
 - ``hedge_storm``    — a node's hedged reads fired at (or beyond) the
                        hedge budget's refill rate for a sustained
                        window (r18: ``firedRecent``/``deniedRecent``,
@@ -113,6 +122,9 @@ REBALANCE_STUCK_S = 120.0  # migrating with no progress this long =
 INDEX_STALE_FACTOR = 10.0  # x the node's configured filter_sync_s
 INDEX_STALE_MIN_S = 60.0   # absolute floor, so a sub-second sync
                         # cadence does not page on one missed round
+TIER_STALL_FACTOR = 5.0    # x the node's configured scan interval
+TIER_STALL_MIN_S = 120.0   # absolute floor, so a sub-second test
+                        # cadence does not page on one slow scan
 HEDGE_STORM_MIN_FIRED = 8  # windowed-fired floor: a handful of hedges
                         # in a minute is the plane working, not a storm
 HEDGE_STORM_WINDOW_S = 60.0  # the serve hedge stats' recency window
@@ -455,6 +467,34 @@ def diagnose(snapshots: dict[int, dict | None],
                                 "probe-skipping placement is trusting "
                                 "a summary that stopped refreshing"})
 
+    def tier_stall() -> None:
+        # a tiering worker that stopped finishing scans fails QUIET:
+        # reads still work (hot files replicated, cold files decode),
+        # only the storage bill stops shrinking — exactly the kind of
+        # silence the doctor exists to name
+        for nid, snap in sorted(live.items()):
+            t = snap.get("tier") or {}
+            if not t.get("enabled"):
+                continue
+            interval = t.get("scanIntervalS")
+            since = t.get("sinceProgressS")
+            if not isinstance(interval, (int, float)) or interval <= 0:
+                continue   # manual-scan node: no cadence promised
+            if not isinstance(since, (int, float)):
+                continue
+            thresh = max(TIER_STALL_MIN_S, TIER_STALL_FACTOR * interval)
+            if since >= thresh:
+                findings.append({
+                    "rule": "tier_stall", "severity": "warning",
+                    "peers": [nid],
+                    "evidence": f"no tiering progress for {since:.0f}s "
+                                f"(scan cadence {interval:g}s, "
+                                f"{t.get('errors', 0)} tier errors, "
+                                f"{t.get('scans', 0)} scans done) — "
+                                "cold data is staying at full "
+                                "replication cost; see its /events "
+                                "journal for tier_error"})
+
     def hedge_storm() -> None:
         # sustained hedging at the budget's refill rate: fired count
         # over the window reaches what the refill could possibly grant
@@ -504,7 +544,8 @@ def diagnose(snapshots: dict[int, dict | None],
     for rule in (dead_peer, slow_peer, shed_storm, credit_starvation,
                  cache_thrash, clock_skew, config_drift, loop_lag,
                  capacity_trend, underreplication, epoch_mismatch,
-                 rebalance_stuck, index_stale, hedge_storm):
+                 rebalance_stuck, index_stale, tier_stall,
+                 hedge_storm):
         try:
             rule()
         except Exception as e:   # noqa: BLE001 — see docstring

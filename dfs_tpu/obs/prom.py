@@ -225,5 +225,30 @@ def render_node_metrics(node) -> str:
                     ("filterFp", "dfs_index_filter_fp")):
                 fam(f"{fam_name}_total", "counter")
                 lines.append(f"{fam_name}_total {ix.get(key, 0)}")
+    # hot/cold tiering plane (r20): demotion/promotion progress and the
+    # bytes the cold tier reclaimed — present only when the plane is on
+    # (additive, like the census/index blocks). getattr-guarded for
+    # standalone/test fakes.
+    tier_stats = getattr(node, "tier_stats", None)
+    if tier_stats is not None:
+        ts = tier_stats()
+        if ts.get("enabled"):
+            for key, fam_name in (
+                    ("ledgerSize", "dfs_tier_ledger_entries"),
+                    ("sinceProgressS", "dfs_tier_since_progress_seconds"),
+                    ("creditStallS", "dfs_tier_credit_stall_seconds")):
+                fam(fam_name, "gauge")
+                lines.append(f"{fam_name} {_fmt(ts.get(key, 0))}")
+            for key, fam_name in (
+                    ("scans", "dfs_tier_scans"),
+                    ("demotedFiles", "dfs_tier_demoted_files"),
+                    ("demotedBytes", "dfs_tier_demoted_bytes"),
+                    ("parityBytes", "dfs_tier_parity_bytes"),
+                    ("reclaimedBytes", "dfs_tier_reclaimed_bytes"),
+                    ("promotedFiles", "dfs_tier_promoted_files"),
+                    ("promotedBytes", "dfs_tier_promoted_bytes"),
+                    ("errors", "dfs_tier_errors")):
+                fam(f"{fam_name}_total", "counter")
+                lines.append(f"{fam_name}_total {ts.get(key, 0)}")
     lines.append("# EOF")   # OpenMetrics required terminator
     return "\n".join(lines) + "\n"

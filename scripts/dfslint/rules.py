@@ -312,7 +312,8 @@ def check_digest_boundary(project: Project) -> Iterator[Finding]:
 # deployment to the default — the drift this rule exists to catch)
 _CLI_CLASSES = ("NodeConfig", "ServeConfig", "IngestConfig", "ObsConfig",
                 "FragmenterConfig", "CensusConfig", "DurabilityConfig",
-                "ChaosConfig", "RingConfig", "IndexConfig", "ClientConfig")
+                "ChaosConfig", "RingConfig", "IndexConfig", "TierConfig",
+                "ClientConfig")
 # config field -> /metrics key that surfaces it, per stats function.
 # "cas" carries cas_io_threads as its nested workers count
 # (store/aio.py stats()).
@@ -392,6 +393,18 @@ _INDEX_METRIC_KEYS = {"enabled": "enabled",
                       "filter_sync_s": "filterSyncS",
                       "background_compact": "backgroundCompact",
                       "echo_cache_entries": "echoCacheEntries"}
+
+# hot/cold tiering knobs surface under /metrics "tier"
+# (node/runtime.py tier_stats())
+_TIER_METRIC_KEYS = {"enabled": "enabled",
+                     "hot_fraction": "hotFraction",
+                     "min_idle_s": "minIdleS",
+                     "scan_interval_s": "scanIntervalS",
+                     "ec_k": "ecK",
+                     "demote_credit_bytes": "demoteCreditBytes",
+                     "half_life_s": "halfLifeS",
+                     "promote_reads": "promoteReads",
+                     "ledger_entries": "ledgerEntries"}
 
 # smart-client knobs surface in SmartClient.stats()
 # (dfs_tpu/client/smart.py) — the SDK's config echo plays the same
@@ -569,6 +582,7 @@ def check_config_drift(project: Project) -> Iterator[Finding]:
             (runtime, "ring_stats", "RingConfig", _RING_METRIC_KEYS),
             (runtime, "index_stats", "IndexConfig",
              _INDEX_METRIC_KEYS),
+            (runtime, "tier_stats", "TierConfig", _TIER_METRIC_KEYS),
             (client_pkg, "stats", "ClientConfig",
              _CLIENT_METRIC_KEYS)):
         if src is None or src.tree is None or cls not in classes:

@@ -551,6 +551,59 @@ def test_dfs005_index_fields_checked(tmp_path):
                            "dfs_tpu/node/runtime.py": runtime_ok}) == []
 
 
+def test_dfs005_tier_fields_checked(tmp_path):
+    """r20: TierConfig rides the same three DFS005 edges — a tiering
+    knob dropped from cmd_serve's constructor, and one whose /metrics
+    key vanishes from tier_stats(), must both be findings; the wired
+    fixture must be clean."""
+    cfg = (
+        "import dataclasses\n"
+        "@dataclasses.dataclass(frozen=True)\n"
+        "class TierConfig:\n"
+        "    hot_fraction: float = 0.1\n"
+        "    ec_k: int = 4\n")
+    cli_missing = (
+        "from dfs_tpu.config import TierConfig\n"
+        "def cmd_serve(args):\n"
+        "    return TierConfig(hot_fraction=args.tier_hot_fraction)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--tier-hot-fraction', type=float,\n"
+        "                     default=0.1)\n")
+    runtime_ok = (
+        "class S:\n"
+        "    def tier_stats(self):\n"
+        "        return {'hotFraction': 0.1, 'ecK': 4}\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/cli/main.py": cli_missing,
+                            "dfs_tpu/node/runtime.py": runtime_ok})
+    assert rules_of(found) == ["DFS005"]
+    assert "TierConfig.ec_k" in found[0].message
+
+    cli_ok = (
+        "from dfs_tpu.config import TierConfig\n"
+        "def cmd_serve(args):\n"
+        "    return TierConfig(hot_fraction=args.tier_hot_fraction,\n"
+        "                      ec_k=args.tier_ec_k)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--tier-hot-fraction', type=float,\n"
+        "                     default=0.1)\n"
+        "    sub.add_argument('--tier-ec-k', type=int, default=4)\n")
+    runtime_missing_key = (
+        "class S:\n"
+        "    def tier_stats(self):\n"
+        "        return {'hotFraction': 0.1}\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/cli/main.py": cli_ok,
+                            "dfs_tpu/node/runtime.py":
+                            runtime_missing_key})
+    assert rules_of(found) == ["DFS005"]
+    assert "ecK" in found[0].message
+
+    assert lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                           "dfs_tpu/cli/main.py": cli_ok,
+                           "dfs_tpu/node/runtime.py": runtime_ok}) == []
+
+
 def test_dfs005_deadline_hedge_fields_checked(tmp_path):
     """r18: the ServeConfig deadline/hedge fields ride the same three
     DFS005 edges — a deadline/hedge knob dropped from cmd_serve's

@@ -425,6 +425,9 @@ async def _route(node: "StorageNodeServer", reader: asyncio.StreamReader,
         snap["tier"] = node.tier_stats()  # hot/cold tiering: ledger +
         # demotion/promotion counters (r20, additive);
         # {"enabled": false} on a tier-less node
+        snap["sim"] = node.sim_stats()  # similarity compression:
+        # sketch/delta counters (r21, additive);
+        # {"enabled": false} on a sim-less node
         return as_json(200, snap)
 
     if method == "GET" and path == "/metrics/history":

@@ -81,6 +81,19 @@ CRASH_POINTS = frozenset({
     # an already-cold file — the torn window where only SOME deletes
     # landed; every remaining read must reconstruct from the stripe
     "demote.before_replica_delete",
+    # similarity plane (dfs_tpu.sim) — ``sim.*`` points fire only when
+    # the plane stores/serves delta chunks (exercised by bench_sim.py
+    # and tests/test_sim.py, like demote.* via test_tiering.py):
+    # ChunkStore delta put: delta file linked, index record NOT yet
+    # written — the false-negative window the stat backstop covers
+    "sim.after_delta_write",
+    # NodeStore.gc: live + delta-pinned sets computed, before any
+    # orphan delete — a crash mid-GC must never have deleted a base
+    # whose delta dependents are live
+    "sim.before_base_gc",
+    # ChunkStore re-materialize-on-hot: raw copy durable, the delta
+    # file NOT yet unlinked — both representations present, raw wins
+    "sim.after_rematerialize",
 })
 
 # knobs POST /chaos may change at runtime (everything except the

@@ -21,7 +21,7 @@ from dfs_tpu.config import (CDCParams, CensusConfig, ChaosConfig,
                             ClusterConfig, DurabilityConfig,
                             FragmenterConfig, IndexConfig, IngestConfig,
                             NodeConfig, ObsConfig, RingConfig,
-                            ServeConfig, TierConfig)
+                            ServeConfig, SimConfig, TierConfig)
 
 
 def _client(args) -> NodeClient:
@@ -129,7 +129,19 @@ def cmd_serve(args) -> int:
             demote_credit_bytes=args.tier_demote_credit_bytes,
             half_life_s=args.tier_half_life,
             promote_reads=args.tier_promote_reads,
+            redemote_cooldown_s=args.tier_redemote_cooldown,
             ledger_entries=args.tier_ledger_entries),
+        sim=SimConfig(
+            enabled=args.sim,
+            sketch_size=args.sim_sketch_size,
+            bands=args.sim_bands,
+            shingle_bytes=args.sim_shingle_bytes,
+            max_candidates=args.sim_max_candidates,
+            min_chunk_bytes=args.sim_min_chunk_bytes,
+            min_savings_frac=args.sim_min_savings_frac,
+            max_delta_depth=args.sim_max_delta_depth,
+            devices=args.sim_devices,
+            rematerialize_reads=args.sim_rematerialize_reads),
         chaos=ChaosConfig(
             enabled=args.chaos,
             seed=args.chaos_seed,
@@ -763,10 +775,54 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--tier-promote-reads", type=float, default=2.0,
                        help="decayed heat at which a cold file "
                             "re-materializes replicated")
+    serve.add_argument("--tier-redemote-cooldown", type=float,
+                       default=0.0,
+                       help="seconds a freshly-promoted file sits out "
+                            "demotion scans (re-demotion hysteresis: a "
+                            "file flapping around the promote threshold "
+                            "must not churn encode/decode; 0 = off)")
     serve.add_argument("--tier-ledger-entries", type=int, default=65536,
                        help="bounded temperature-ledger size (stalest "
                             "digests evict first — eviction reads as "
                             "cold)")
+    serve.add_argument("--sim", action="store_true",
+                       help="enable the similarity compression plane "
+                            "(docs/similarity.md): min-hash sketches on "
+                            "ingest, LSH candidate lookup, and "
+                            "delta-encoded chunk storage against "
+                            "similar resident bases, transparent on "
+                            "read")
+    serve.add_argument("--sim-sketch-size", type=int, default=16,
+                       help="min-hash lanes per sketch (more = finer "
+                            "similarity resolution, linearly more "
+                            "sketch compute)")
+    serve.add_argument("--sim-bands", type=int, default=4,
+                       help="LSH bands the sketch folds into (must "
+                            "divide the sketch size; more bands = more "
+                            "recall, more candidates)")
+    serve.add_argument("--sim-shingle-bytes", type=int, default=8,
+                       help="bytes per rolling shingle the sketch "
+                            "hashes over")
+    serve.add_argument("--sim-max-candidates", type=int, default=8,
+                       help="bounded candidate-set size per lookup — "
+                            "each candidate costs a base read + trial "
+                            "encode on the CAS worker")
+    serve.add_argument("--sim-min-chunk-bytes", type=int, default=4096,
+                       help="chunks below this skip sketching entirely "
+                            "(delta headers would eat the savings)")
+    serve.add_argument("--sim-min-savings-frac", type=float, default=0.5,
+                       help="store a delta only when its size is at or "
+                            "below this fraction of the raw chunk")
+    serve.add_argument("--sim-max-delta-depth", type=int, default=3,
+                       help="longest base chain a reconstruction may "
+                            "walk (caps read amplification)")
+    serve.add_argument("--sim-devices", type=int, default=0,
+                       help="devices to shard sketch batches over "
+                            "(0/1 = host oracle; >1 = chunks-over-dp "
+                            "on the mesh, byte-identical output)")
+    serve.add_argument("--sim-rematerialize-reads", type=int, default=0,
+                       help="reconstructions after which a hot delta is "
+                            "re-materialized as a raw chunk (0 = never)")
     serve.add_argument("--chaos", action="store_true",
                        help="enable the fault-injection plane "
                             "(docs/chaos.md): the knobs below apply "

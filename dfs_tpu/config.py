@@ -578,6 +578,13 @@ class TierConfig:
                                     # file re-materializes replicated
     ledger_entries: int = 65536     # bounded temperature-ledger size
                                     # (LRU beyond it)
+    redemote_cooldown_s: float = 0.0  # after a promotion, the file is
+                                    # NOT demotion-eligible again for
+                                    # this long — hysteresis so a file
+                                    # flapping around promote_reads
+                                    # doesn't churn encode/decode
+                                    # cycles; 0 = historical behavior
+                                    # (eligible immediately)
 
     def __post_init__(self) -> None:
         if not 0.0 <= self.hot_fraction <= 1.0:
@@ -596,6 +603,82 @@ class TierConfig:
             raise ValueError("promote_reads must be >= 0")
         if self.ledger_entries < 256:
             raise ValueError("ledger_entries must be >= 256")
+        if self.redemote_cooldown_s < 0:
+            raise ValueError("redemote_cooldown_s must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Similarity-compression plane (dfs_tpu.sim, docs/similarity.md):
+    batched min-hash sketches over ingest chunks, a banded similarity
+    lookup, and delta-encoded chunk storage in the CAS.
+
+    EVERYTHING defaults off: ``SimConfig()`` builds no sketch kernel,
+    no band index and no delta tree — ``ChunkStore`` reads and writes
+    raw chunk files on byte-identical code paths to a pre-sim build
+    (the chaos/serve/index/tier default-off discipline, asserted by
+    tests/test_sim.py). ``enabled=True`` builds the
+    :class:`~dfs_tpu.sim.SimPlane`:
+
+    - every locally stored chunk is sketched (``sketch_size`` min-hash
+      lanes over ``shingle_bytes``-byte shingles, batched over the
+      mesh's dp axis when ``devices > 1``, NumPy oracle otherwise or
+      on degraded envs — byte-identical either way);
+    - the sketch's ``bands`` band keys feed a crash-safe append-only
+      band log; a new chunk's bands look up at most ``max_candidates``
+      resident base candidates;
+    - a chunk whose best candidate delta-encodes below
+      ``min_savings_frac`` of its raw size is stored as
+      ``base-digest + patch`` (transparent on read: resolve base,
+      apply patch, sha256-verify), chains capped at ``max_delta_depth``
+      and re-materialized raw after ``rematerialize_reads`` reads.
+    """
+
+    enabled: bool = False
+    sketch_size: int = 16           # min-hash lanes per sketch (uint32
+                                    # each); bands must divide it
+    bands: int = 4                  # LSH bands per sketch — each band
+                                    # of sketch_size/bands lanes is one
+                                    # secondary lookup key
+    shingle_bytes: int = 8          # bytes per rolling shingle feature
+    max_candidates: int = 8         # resident base candidates consulted
+                                    # per new chunk (bounded work)
+    min_chunk_bytes: int = 4096     # chunks smaller than this are never
+                                    # sketched or delta-encoded (patch
+                                    # overhead dominates)
+    min_savings_frac: float = 0.5   # store a delta only if the patch is
+                                    # at most this fraction of the raw
+                                    # size (0.5 = patch must halve it)
+    max_delta_depth: int = 3        # longest base chain a reconstruct
+                                    # may walk; a chunk at the cap is
+                                    # stored raw and never a base issue
+    devices: int = 0                # shard sketch batches over this
+                                    # many mesh devices (0/1 = NumPy
+                                    # oracle on the host)
+    rematerialize_reads: int = 0    # delta reads before the chunk is
+                                    # re-materialized raw (read-
+                                    # amplification bound); 0 = never
+
+    def __post_init__(self) -> None:
+        if self.sketch_size < 1:
+            raise ValueError("sketch_size must be >= 1")
+        if not 1 <= self.bands <= self.sketch_size \
+                or self.sketch_size % self.bands:
+            raise ValueError("bands must divide sketch_size")
+        if not 1 <= self.shingle_bytes <= 64:
+            raise ValueError("shingle_bytes must be within [1, 64]")
+        if self.max_candidates < 1:
+            raise ValueError("max_candidates must be >= 1")
+        if self.min_chunk_bytes < 0:
+            raise ValueError("min_chunk_bytes must be >= 0")
+        if not 0.0 < self.min_savings_frac <= 1.0:
+            raise ValueError("min_savings_frac must be within (0, 1]")
+        if self.max_delta_depth < 1:
+            raise ValueError("max_delta_depth must be >= 1")
+        if self.devices < 0:
+            raise ValueError("devices must be >= 0")
+        if self.rematerialize_reads < 0:
+            raise ValueError("rematerialize_reads must be >= 0")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -751,6 +834,10 @@ class NodeConfig:
     # builds NO ledger and NO worker — reads, repair and census run
     # byte-identical code paths to a pre-tier build
     tier: TierConfig = dataclasses.field(default_factory=TierConfig)
+    # similarity-compression plane (dfs_tpu.sim): the default
+    # SimConfig() builds NO sketcher, NO band index and NO delta tree —
+    # the CAS stores raw chunk files on pre-sim code paths exactly
+    sim: SimConfig = dataclasses.field(default_factory=SimConfig)
 
     @property
     def self_addr(self) -> PeerAddr:

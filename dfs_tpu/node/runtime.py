@@ -396,6 +396,19 @@ class StorageNodeServer:
 
             self.tier = TierPlane(cfg.tier, self.store.root / "tier",
                                   obs=self.obs)
+        # similarity compression plane (dfs_tpu.sim, docs/similarity.md):
+        # None unless SimConfig.enabled — the default node's put/get
+        # paths stay byte-identical (the ChunkStore sim seam is one None
+        # check). Built after chaos so the sim.* crash points fire on
+        # the real delta write / GC / re-materialize paths.
+        self.sim = None
+        if cfg.sim.enabled:
+            from dfs_tpu.sim import SimPlane
+
+            self.sim = SimPlane(cfg.sim, self.store.root / "sim")
+            if self.chaos is not None:
+                self.sim.crash = self.chaos.maybe_crash
+            self.store.chunks.sim = self.sim
         # census/capacity plane (docs/observability.md): the embedded
         # metrics-history ring a background sampler feeds — trend data
         # for GET /metrics/history and the doctor's capacity_trend
@@ -523,6 +536,10 @@ class StorageNodeServer:
         self.health.stop()
         self.client.close()   # drop pooled peer connections
         self.cas.close()      # async CAS tier workers (non-blocking)
+        if self.sim is not None:
+            # band-log close + dir fsync (losing buffered adds is the
+            # safe direction — missed dedup, never wrong bytes)
+            await asyncio.to_thread(self.sim.close)
         if self.index is not None:
             # flush the WAL buffer + close run fds; off the loop (file
             # I/O). In-flight CAS jobs racing the close lose only
@@ -1200,6 +1217,12 @@ class StorageNodeServer:
                         refused.append(d)
                     elif self.store.chunks.delete(d):
                         removed.append(d)
+                    elif self.store.chunks.delta_pinned(d):
+                        # delta base (similarity plane): resident deltas
+                        # reconstruct through it — refused like an owned
+                        # chunk; the caller retries after the dependents
+                        # die or re-materialize
+                        refused.append(d)
                 return removed, refused
 
             removed, refused = await asyncio.to_thread(reclaim)
@@ -4687,8 +4710,9 @@ class StorageNodeServer:
         a replica and re-replicates. The reference's only integrity check
         runs at read time on the whole file (StorageNode.java:453-458);
         scrubbing finds rot before a read does."""
-        scanned = corrupt = 0
-        digests = self.store.chunks.digests()
+        scanned = corrupt = delta_missing_base = 0
+        ch = self.store.chunks
+        digests = ch.digests()
         # read+hash happen OFF the event loop in worker-thread batches
         # (chunks are up to max_chunk bytes; hashing one inline would
         # stall live requests — upload/download already to_thread theirs),
@@ -4697,27 +4721,75 @@ class StorageNodeServer:
         for i in range(0, len(digests), batch_n):
             batch = digests[i:i + batch_n]
 
-            def read_and_hash(ds=batch) -> list[tuple[str, bool]]:
-                present = [(d, b) for d in ds
-                           if (b := self.store.chunks.get(d)) is not None]
+            def read_and_hash(ds=batch) -> list[tuple[str, str]]:
+                # pre-capture delta residency so an absent read can be
+                # classified: a delta get() dropped as corrupt looks
+                # exactly like a raw chunk deleted mid-scrub otherwise
+                pre = {d: ch.delta_base(d) for d in ds} \
+                    if ch.delta_count() else {}
+                blobs = [(d, ch.get(d)) for d in ds]
+                present = [(d, b) for d, b in blobs if b is not None]
                 hexes = sha256_many_hex([b for _, b in present])
-                return [(d, h == d) for (d, _), h in zip(present, hexes)]
+                okmap = {d: h == d for (d, _), h in zip(present, hexes)}
+                out = []
+                for d, b in blobs:
+                    if b is not None:
+                        out.append((d, "ok" if okmap[d] else "corrupt"))
+                    elif pre.get(d):
+                        if ch.delta_base(d):
+                            # delta resident but unreadable: the base
+                            # chain is broken — find the first
+                            # unresolvable link and queue THAT for
+                            # repair instead of declaring the delta
+                            # corrupt (docs/similarity.md)
+                            cur = d
+                            while (nb := ch.delta_base(cur)) is not None:
+                                cur = nb
+                            out.append((d, f"base:{cur}"))
+                        else:
+                            # get() dropped it (structural damage or
+                            # digest mismatch): corrupt
+                            out.append((d, "corrupt"))
+                return out
 
-            for d, ok in await asyncio.to_thread(read_and_hash):
+            for d, status in await asyncio.to_thread(read_and_hash):
                 scanned += 1
-                if not ok:
-                    corrupt += 1
-                    self.store.chunks.delete(d)
-                    self.serve.drop_cached([d])
-                    self.under_replicated.add(d)
-                    self.log.warning("scrub: corrupt chunk %s deleted",
-                                     d[:12])
+                if status == "ok":
+                    continue
+                if status.startswith("base:"):
+                    base_d = status[5:]
+                    delta_missing_base += 1
+                    self.under_replicated.add(base_d)
+                    self.log.warning(
+                        "scrub: delta %s missing base %s — queued for "
+                        "repair", d[:12], base_d[:12])
+                    continue
+                corrupt += 1
+                if not ch.delete(d) and ch.delta_pinned(d):
+                    # corrupt PINNED base: its dependent deltas all
+                    # reconstruct through the rotten bytes — they are
+                    # lost too. Cascade deepest-first (each delete
+                    # releases the next pin), queue everything for
+                    # repair, then the base delete succeeds.
+                    for dep in ch.delta_dependents(d):
+                        if ch.delete(dep):
+                            self.serve.drop_cached([dep])
+                            self.under_replicated.add(dep)
+                    ch.delete(d)
+                self.serve.drop_cached([d])
+                self.under_replicated.add(d)
+                self.log.warning("scrub: corrupt chunk %s deleted",
+                                 d[:12])
         self.counters.inc("scrubs")
         if corrupt:
             self.counters.inc("scrub_corrupt", corrupt)
             self.obs.event("scrub_corrupt", scanned=scanned,
                            corrupt=corrupt)
-        out = {"scanned": scanned, "corrupt": corrupt}
+        if delta_missing_base:
+            self.counters.inc("scrub_delta_missing_base",
+                              delta_missing_base)
+        out = {"scanned": scanned, "corrupt": corrupt,
+               "deltaMissingBase": delta_missing_base}
         if self.index is not None:
             healed = await asyncio.to_thread(
                 self._scrub_index_heal, digests)
@@ -4837,6 +4909,13 @@ class StorageNodeServer:
             for fid in sorted(cold):
                 if fid in self._tier_promoting:
                     continue      # racing promotion wins: it has reads
+                if plane.in_redemote_cooldown(fid, now=now):
+                    # re-demotion hysteresis: freshly-promoted files sit
+                    # out the scan for redemote_cooldown_s, so a file
+                    # flapping around promote_reads cannot churn the
+                    # encode/decode cycle every scan (docs/tiering.md)
+                    out["cooldown"] = out.get("cooldown", 0) + 1
+                    continue
                 try:
                     if await self._demote_file(by_id[fid]):
                         out["demoted"] += 1
@@ -5067,6 +5146,7 @@ class StorageNodeServer:
             await self._tier_reclaim_parity(m)
             plane.promoted_files += 1
             plane.promoted_bytes += m.size
+            plane.note_promoted(m.file_id)   # re-demotion hysteresis
             plane.note_progress()
             self.counters.inc("tier_promotions")
             self.obs.event("tier_promote", fileId=m.file_id,
@@ -5152,6 +5232,7 @@ class StorageNodeServer:
                "demoteCreditBytes": t.demote_credit_bytes,
                "halfLifeS": t.half_life_s,
                "promoteReads": t.promote_reads,
+               "redemoteCooldownS": t.redemote_cooldown_s,
                "ledgerEntries": t.ledger_entries}
         if plane is None:
             return {"enabled": False}
@@ -5168,4 +5249,30 @@ class StorageNodeServer:
         out["sinceProgressS"] = round(
             time.monotonic() - plane.last_progress_at, 3)
         out["admission"] = plane.gate.stats()
+        return out
+
+    def sim_stats(self) -> dict:
+        """``/metrics`` ``sim`` section. The enabled/sketchSize/bands/
+        shingleBytes/maxCandidates/minChunkBytes/minSavingsFrac/
+        maxDeltaDepth/devices/rematerializeReads keys mirror SimConfig
+        fields (dfslint DFS005 checks the config ⇄ CLI ⇄ metrics
+        mapping); the rest is live plane + store state.
+        ``{"enabled": False}`` is the whole story for the default
+        sim-less node."""
+        s = self.cfg.sim
+        plane = self.sim
+        out = {"enabled": s.enabled,
+               "sketchSize": s.sketch_size,
+               "bands": s.bands,
+               "shingleBytes": s.shingle_bytes,
+               "maxCandidates": s.max_candidates,
+               "minChunkBytes": s.min_chunk_bytes,
+               "minSavingsFrac": s.min_savings_frac,
+               "maxDeltaDepth": s.max_delta_depth,
+               "devices": s.devices,
+               "rematerializeReads": s.rematerialize_reads}
+        if plane is None:
+            return {"enabled": False}
+        out.update(plane.stats())
+        out["deltaChunks"] = self.store.chunks.delta_count()
         return out

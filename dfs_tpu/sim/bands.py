@@ -1,0 +1,127 @@
+"""Crash-safe similarity band index (dfs_tpu.sim, docs/similarity.md).
+
+Maps LSH band keys (``sketch.band_keys``) to the recent local digests
+that produced them — the bounded candidate set a new chunk's bands look
+up before delta encoding. Follows the r16 log-structured discipline in
+miniature:
+
+- ONE append-only log (``bands.log``) of fixed-size CRC-framed records;
+  a torn tail (kill -9 mid-append) is truncated at the first bad record
+  on replay — every surviving record was fully written;
+- adds are buffered writes with NO fsync: losing the tail of the log is
+  the SAFE direction (a missed dedup opportunity, never wrong bytes —
+  candidates are verified against resident chunk content before any
+  delta is written);
+- the in-memory map is bounded per key (newest candidates win) and
+  rebuilt from the log at open; anything structurally wrong with the
+  file degrades to an empty index, because the chunk files are the
+  ground truth and the band index is only an optimization.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import struct
+import threading
+import zlib
+from pathlib import Path
+
+_REC = struct.Struct(">IQ32s")     # crc32(key||digest), band key, digest
+
+
+class BandIndex:
+    """Bounded band-key -> recent-digests map over an append-only log.
+    Thread-safe: adds arrive from the CAS worker threads."""
+
+    def __init__(self, root: Path, per_key: int = 8) -> None:
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.path = self.root / "bands.log"
+        self.per_key = max(1, int(per_key))
+        self._mu = threading.Lock()
+        self._map: dict[int, collections.deque[str]] = {}
+        self.replayed = 0
+        self.truncated = 0
+        self._replay()
+        self._fh = open(self.path, "ab")
+
+    def _replay(self) -> None:
+        try:
+            blob = self.path.read_bytes()
+        except OSError:
+            return
+        good = 0
+        while good + _REC.size <= len(blob):
+            crc, key, raw = _REC.unpack_from(blob, good)
+            if crc != zlib.crc32(blob[good + 4:good + _REC.size]):
+                break
+            self._note(key, raw.hex())
+            good += _REC.size
+            self.replayed += 1
+        if good < len(blob):
+            # torn tail: truncate so the next append starts on a record
+            # boundary (the r16 WAL discipline)
+            self.truncated = len(blob) - good
+            with open(self.path, "r+b") as fh:
+                fh.truncate(good)
+
+    def _note(self, key: int, digest: str) -> None:
+        dq = self._map.get(key)
+        if dq is None:
+            dq = self._map[key] = collections.deque(maxlen=self.per_key)
+        if digest in dq:
+            dq.remove(digest)
+        dq.appendleft(digest)
+
+    def add(self, digest: str, keys: list[int]) -> None:
+        """Record ``digest`` under its band keys (buffered append; no
+        fsync — see module docstring for why losing it is safe)."""
+        raw = bytes.fromhex(digest)
+        with self._mu:
+            for key in keys:
+                body = _REC.pack(0, key, raw)[4:]
+                self._fh.write(struct.pack(">I", zlib.crc32(body)) + body)
+                self._note(key, digest)
+            self._fh.flush()
+
+    def lookup(self, keys: list[int], exclude: str | None = None,
+               limit: int = 8) -> list[str]:
+        """Candidate digests sharing any band with ``keys`` — unique,
+        newest first, at most ``limit``."""
+        out: list[str] = []
+        seen = {exclude} if exclude else set()
+        with self._mu:
+            for key in keys:
+                for d in self._map.get(key, ()):
+                    if d not in seen:
+                        seen.add(d)
+                        out.append(d)
+                        if len(out) >= limit:
+                            return out
+        return out
+
+    def __len__(self) -> int:
+        with self._mu:
+            return sum(len(dq) for dq in self._map.values())
+
+    def keys_total(self) -> int:
+        with self._mu:
+            return len(self._map)
+
+    def close(self) -> None:
+        with self._mu:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+        # sync the log's directory entry once at shutdown so a clean
+        # stop persists the index across an immediate power cut
+        try:
+            fd = os.open(self.root, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass

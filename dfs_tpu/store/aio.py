@@ -159,9 +159,12 @@ class AsyncChunkStore:
         if not items:
             return []
         its = list(items)
+        # put_batch, not a put loop: with the similarity plane attached
+        # the store sketches the whole batch through the mesh in one
+        # launch; without it, put_batch IS the per-item loop
         return await self._run(
             self._wpool,
-            lambda: [self.store.put(d, b, verify=verify) for d, b in its],
+            lambda: self.store.put_batch(its, verify=verify),
             "cas.put_many")
 
     async def inventory(self, list_prefixes=None,

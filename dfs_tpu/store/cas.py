@@ -26,6 +26,11 @@ import time
 from pathlib import Path
 
 from dfs_tpu.meta.manifest import Manifest
+# the delta codec is import-light (numpy + stdlib; dfs_tpu.sim keeps the
+# sketch/JAX stack out of its package __init__) — safe at module level
+from dfs_tpu.sim.delta import HEADER_BYTES as _DELTA_HEADER_BYTES
+from dfs_tpu.sim.delta import apply_delta as _apply_delta
+from dfs_tpu.sim.delta import parse_header as _parse_delta_header
 from dfs_tpu.utils.hashing import is_hex_digest
 from dfs_tpu.utils.hashing import sha256_hex
 
@@ -133,6 +138,21 @@ class ChunkStore:
         self._index_mu = threading.Lock()
         self._dirs: set[str] = set()       # subdirs known to exist
         self._tmp_seq = itertools.count()  # cheap unique tmp names
+        # similarity seam (dfs_tpu.sim.SimPlane): when set, eligible
+        # puts may store a DELTA (base-digest + patch, dfs_tpu.sim.
+        # delta) under ``deltas/<d[:2]>/<digest>`` instead of the raw
+        # file, and get() reconstructs transparently. None (the
+        # default) keeps every pre-sim path byte-identical; the delta
+        # tree is consulted ONLY once it exists on disk, so a
+        # default-off store never even stats it.
+        self.sim = None
+        self._deltas_root = f"{self._root_str}/deltas"
+        self._delta_mu = threading.Lock()  # guards the two maps below
+        self._delta_base: dict[str, str] = {}   # delta digest -> base
+        self._delta_refs: dict[str, int] = {}   # base -> live dependents
+        self._have_deltas = os.path.isdir(self._deltas_root)
+        if self._have_deltas:
+            self._prime_delta_maps()
 
     def _path(self, digest: str) -> Path:
         if not is_hex_digest(digest):
@@ -146,6 +166,131 @@ class ChunkStore:
         if not is_hex_digest(digest):
             raise ValueError(f"bad digest {digest!r}")
         return f"{self._root_str}/{digest[:2]}/{digest}"
+
+    # -- delta tree (similarity plane) ---------------------------------
+    #
+    # A delta-stored chunk lives at deltas/<d[:2]>/<digest> INSIDE the
+    # store root. The legacy scans never see it: digests()' inner loop
+    # filters on 64-hex names (the 2-hex fan-out dirs under deltas/
+    # fail that) and inventory()'s bucket walk filters subdirs on
+    # PREFIX_HEX-length names ("deltas" fails that). The raw path
+    # always wins when both exist (a crash mid-re-materialize), so
+    # there is never an ambiguity about which bytes a digest serves.
+
+    def _delta_path_str(self, digest: str) -> str:
+        if not is_hex_digest(digest):
+            raise ValueError(f"bad digest {digest!r}")
+        return f"{self._deltas_root}/{digest[:2]}/{digest}"
+
+    def _deltas_possible(self) -> bool:
+        """Locked read of the deltas-on-disk flag (written under
+        ``_delta_mu`` by the first delta put) — False short-circuits
+        every delta path, so a plane-less store pays one uncontended
+        lock at most and no extra stats."""
+        with self._delta_mu:
+            return self._have_deltas
+
+    def _prime_delta_maps(self) -> None:
+        """Rebuild the delta dependency maps from the on-disk headers
+        (one 41-byte read per delta) at open. The maps are the pin
+        ground truth for delete/GC refusal and need no separate
+        persistence — the delta files ARE the log. A delta whose raw
+        twin exists is a crash between re-materialize and unlink: the
+        raw copy wins, so the unlink is completed here."""
+        droot = Path(self._deltas_root)
+        hexdigits = set("0123456789abcdef")
+        for sub in sorted(droot.iterdir()) if droot.is_dir() else []:
+            if not sub.is_dir():
+                continue
+            for p in sub.iterdir():
+                d = p.name
+                if len(d) != 64 or not set(d) <= hexdigits:
+                    continue
+                if os.path.isfile(self._path_str(d)):
+                    try:
+                        p.unlink()
+                    # completing a previous life's interrupted
+                    # re-materialize is best-effort; the raw file keeps
+                    # serving either way
+                    except OSError:  # dfslint: ignore[DFS007]
+                        pass
+                    continue
+                try:
+                    with open(p, "rb") as f:
+                        base_d, _ = _parse_delta_header(
+                            f.read(_DELTA_HEADER_BYTES))
+                # unreadable/corrupt header at boot: leave the file —
+                # the read path classifies and drops it with counters
+                except (OSError, ValueError):  # dfslint: ignore[DFS007]
+                    continue
+                self._delta_base[d] = base_d
+                self._delta_refs[base_d] = \
+                    self._delta_refs.get(base_d, 0) + 1
+
+    def delta_base(self, digest: str) -> str | None:
+        """Base digest of a delta-stored chunk, None when raw/absent."""
+        with self._delta_mu:
+            return self._delta_base.get(digest)
+
+    def delta_pinned(self, digest: str) -> bool:
+        """True when resident deltas reconstruct through ``digest`` —
+        delete()/GC must refuse it (docs/similarity.md)."""
+        with self._delta_mu:
+            return self._delta_refs.get(digest, 0) > 0
+
+    def delta_count(self) -> int:
+        with self._delta_mu:
+            return len(self._delta_base)
+
+    def delta_dependents(self, digest: str) -> list[str]:
+        """Resident deltas whose base CHAIN passes through ``digest`` —
+        everything a corrupt or lost base invalidates. Ordered deepest
+        first, so deleting in order releases each pin before its
+        holder is attempted (the scrub cascade rides this)."""
+        with self._delta_mu:
+            children: dict[str, list[str]] = {}
+            for k, v in self._delta_base.items():
+                children.setdefault(v, []).append(k)
+        out: list[str] = []
+        frontier = [digest]
+        seen = {digest}
+        while frontier:
+            nxt = []
+            for b in frontier:
+                for k in children.get(b, ()):
+                    if k not in seen:
+                        seen.add(k)
+                        out.append(k)
+                        nxt.append(k)
+            frontier = nxt
+        out.reverse()
+        return out
+
+    def delta_depth(self, digest: str) -> int:
+        """Chain length above ``digest``: 0 = raw-resident, N = a delta
+        N hops from raw, -1 = absent or broken chain."""
+        depth = 0
+        cur = digest
+        for _ in range(64):
+            with self._delta_mu:
+                base = self._delta_base.get(cur)
+            if base is None:
+                return depth if os.path.isfile(self._path_str(cur)) else -1
+            depth += 1
+            cur = base
+        return -1
+
+    def _chain_resolves(self, digest: str) -> bool:
+        """True when ``digest`` reconstructs: its delta chain (possibly
+        zero-length) ends at a raw-resident file."""
+        cur = digest
+        for _ in range(64):
+            with self._delta_mu:
+                base = self._delta_base.get(cur)
+            if base is None:
+                return os.path.isfile(self._path_str(cur))
+            cur = base
+        return False
 
     def has(self, digest: str) -> bool:
         """Local existence. With the index plane attached, a positive
@@ -165,11 +310,15 @@ class ChunkStore:
         forever — and the first post-restart repair probe sweep
         re-indexes everything it touches."""
         if self.index is None:
-            return os.path.isfile(self._path_str(digest))
+            return os.path.isfile(self._path_str(digest)) \
+                or (self._deltas_possible()
+                    and self._chain_resolves(digest))
         if self.index.lookup(digest):
             return True
         with self._index_mu:
-            present = os.path.isfile(self._path_str(digest))
+            present = os.path.isfile(self._path_str(digest)) \
+                or (self._deltas_possible()
+                    and self._chain_resolves(digest))
             if present:
                 self.index.note_put(digest, defer_flush=True)
         if present:
@@ -182,7 +331,8 @@ class ChunkStore:
         digest (:meth:`AsyncChunkStore.has_many`)."""
         return [self.has(d) for d in digests]
 
-    def put(self, digest: str, data: bytes, verify: bool = True) -> bool:
+    def put(self, digest: str, data: bytes, verify: bool = True,
+            sketch=None) -> bool:
         """Store a chunk. Returns False if it already existed (dedup hit).
         Idempotent and safe under concurrent identical writes: the
         visible write is an os.link of a temp file, which atomically
@@ -193,7 +343,13 @@ class ChunkStore:
 
         With ``fsync`` on, the payload file is fsync'd before the link
         and the directory after it — the put is crash-durable when it
-        returns (the fsync-before-ack contract, docs/chaos.md)."""
+        returns (the fsync-before-ack contract, docs/chaos.md).
+
+        With the similarity plane attached (``self.sim``), an eligible
+        new chunk may be stored as a DELTA against a resident similar
+        base instead of raw — transparent to every reader via get().
+        ``sketch`` optionally carries a precomputed min-hash from the
+        batched path (``put_batch``) so the plane need not re-sketch."""
         if self.fault is not None:
             self.fault("put", digest)
         p = self._path_str(digest)
@@ -209,8 +365,38 @@ class ChunkStore:
                         self.index.note_put(digest, defer_flush=True)
                 self.index.maybe_flush()
             return False
+        if self._deltas_possible():
+            with self._delta_mu:
+                if digest in self._delta_base:
+                    return False   # present (as a delta): dedup hit
         if verify and sha256_hex(data) != digest:
             raise ValueError(f"data does not match digest {digest[:12]}…")
+        if self.sim is not None:
+            enc = self.sim.encode_for_put(self, digest, data,
+                                          sketch=sketch)
+            if enc is not None:
+                stored = self._put_delta(digest, enc[0], enc[1],
+                                         raw_len=len(data))
+                if stored is not None:
+                    return stored
+                # rolled back (base vanished mid-write): store raw below
+        return self._put_raw(digest, p, data)
+
+    def put_batch(self, items, verify: bool = True) -> list[bool]:
+        """Batched puts — the seam ``AsyncChunkStore.put_many`` rides so
+        the similarity plane can sketch a whole batch through the mesh
+        in one launch instead of per-chunk on the host. Without the
+        plane this is exactly the per-item loop."""
+        if self.sim is None:
+            return [self.put(d, b, verify=verify) for d, b in items]
+        sketches = self.sim.sketch_for_batch(self, items)
+        return [self.put(d, b, verify=verify, sketch=sketches.get(d))
+                for d, b in items]
+
+    def _put_raw(self, digest: str, p: str, data: bytes) -> bool:
+        """The raw-file write mechanics (tmp + O_EXCL + link + fsync) —
+        shared by put() and re-materialization, which must bypass the
+        sim seam (re-encoding what it just reconstructed would loop)."""
         parent = os.path.dirname(p)
         if parent not in self._dirs:       # one mkdir per subdir lifetime
             os.makedirs(parent, exist_ok=True)
@@ -289,6 +475,137 @@ class ChunkStore:
             self.index.maybe_flush()   # outside the ordering mutex
         return True
 
+    def _put_delta(self, digest: str, base_digest: str, blob: bytes,
+                   raw_len: int) -> bool | None:
+        """Store ``digest`` as a delta blob against ``base_digest``,
+        with the same tmp + O_EXCL + link + fsync discipline as raw
+        puts. Returns True (stored), False (lost the link race — the
+        chunk is present), or None: the base vanished between the
+        encoder's read and the pin registration below (a delete/GC
+        completing in that window), so the write was rolled back and
+        the caller must store raw. Once the pin IS registered (inside
+        the same ordering mutex delete() takes), no later delete can
+        remove the base."""
+        parent = f"{self._deltas_root}/{digest[:2]}"
+        if parent not in self._dirs:
+            os.makedirs(parent, exist_ok=True)
+            self._dirs.add(parent)
+        while True:
+            tmp = f"{parent}/.tmp-{os.getpid()}-{next(self._tmp_seq)}"
+            try:
+                fd = os.open(tmp,
+                             os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+                break
+            except FileExistsError:
+                continue
+        dp = f"{parent}/{digest}"
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+                if self._fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            with self._index_mu:
+                try:
+                    os.link(tmp, dp)
+                except FileExistsError:
+                    return False   # racing identical delta: present
+                except OSError as e:
+                    # same no-hardlink fallback story as _put_raw
+                    if e.errno not in (errno.EPERM, errno.EOPNOTSUPP,
+                                       errno.ENOTSUP, errno.EMLINK):
+                        raise
+                    os.replace(tmp, dp)
+                with self._delta_mu:
+                    self._delta_base[digest] = base_digest
+                    self._delta_refs[base_digest] = \
+                        self._delta_refs.get(base_digest, 0) + 1
+                    self._have_deltas = True
+                if self.index is not None:
+                    self.index.note_put(digest, defer_flush=True)
+            if self._fsync:
+                _fsync_path(parent)
+                with self._count_lock:
+                    self._fsyncs += 1
+        finally:
+            try:
+                os.unlink(tmp)       # ours: the O_EXCL open succeeded
+            # already consumed by os.replace on the no-hardlink path, or
+            # re-leaked to the aged sweep — either way non-fatal cleanup
+            except OSError:  # dfslint: ignore[DFS007]
+                pass
+        with self._count_lock:
+            if self._count is not None:
+                self._count += 1
+            if self._bytes is not None:
+                self._bytes += len(blob)
+        if not self._chain_resolves(base_digest):
+            # the base was deleted between the encoder reading it and
+            # the pin above becoming visible: roll back and store raw
+            self._drop_delta(digest)
+            return None
+        if self.sim is not None:
+            # crash seam: delta linked + durable, index record still in
+            # the WAL buffer and the band-log append unfsynced — the
+            # false-NEGATIVE window chaos must prove harmless
+            self.sim.maybe_crash("sim.after_delta_write")
+            self.sim.note_delta_stored(raw_len, len(blob))
+        if self.index is not None:
+            self.index.maybe_flush()   # outside the ordering mutex
+        return True
+
+    def _drop_delta(self, digest: str) -> bool:
+        """Unlink a delta file and release its base pin (rollback,
+        corruption, re-materialize completion, or delete of a dead
+        delta). The index delete-record is skipped when the digest is
+        still raw-resident — re-materialize leaves the chunk present."""
+        dp = self._delta_path_str(digest)
+        blob_len = 0
+        with self._index_mu:
+            with self._delta_mu:
+                base = self._delta_base.pop(digest, None)
+                if base is not None:
+                    n = self._delta_refs.get(base, 0) - 1
+                    if n > 0:
+                        self._delta_refs[base] = n
+                    else:
+                        self._delta_refs.pop(base, None)
+            try:
+                blob_len = os.path.getsize(dp)
+            # already gone (a racing drop): map cleanup above is all
+            # that was left to do
+            except OSError:  # dfslint: ignore[DFS007]
+                return False
+            if self.index is not None \
+                    and not os.path.isfile(self._path_str(digest)):
+                self.index.note_delete(digest, defer_flush=True)
+            try:
+                os.unlink(dp)
+            except FileNotFoundError:
+                return False
+        with self._count_lock:
+            if self._count is not None:
+                self._count -= 1
+            if self._bytes is not None:
+                self._bytes -= blob_len
+        if self.sim is not None:
+            self.sim.note_delta_dropped(blob_len)
+        if self.index is not None:
+            self.index.maybe_flush()
+        return True
+
+    def _rematerialize(self, digest: str, data: bytes) -> None:
+        """Promote a hot delta back to a raw file (read-count policy in
+        SimPlane.note_delta_read). Raw is written FIRST, the delta
+        unlinked after — a crash between the two leaves both, raw wins
+        on read, and _prime_delta_maps completes the unlink next boot."""
+        p = self._path_str(digest)
+        if not os.path.isfile(p):
+            self._put_raw(digest, p, data)
+        if self.sim is not None:
+            self.sim.maybe_crash("sim.after_rematerialize")
+        self._drop_delta(digest)
+
     def fsync_count(self) -> int:
         """Durability barriers issued so far (``/metrics`` durability)."""
         with self._count_lock:
@@ -301,7 +618,52 @@ class ChunkStore:
             with open(self._path_str(digest), "rb") as f:
                 return f.read()
         except FileNotFoundError:
+            if not self._deltas_possible():
+                return None
+            return self._get_delta(digest, 0)
+
+    def _get_delta(self, digest: str, depth: int) -> bytes | None:
+        """Transparent delta reconstruction: read the delta blob,
+        resolve the base (recursively — bases may themselves be
+        deltas, bounded), apply, and verify sha256 == digest before
+        serving (DFS004: the boundary check rides sha256_hex).
+        Structural damage or a digest mismatch drops the delta exactly
+        like a corrupt raw chunk — scrub/repair re-fetches from
+        replicas. A missing base is reported ABSENT (not corrupt):
+        scrub heals it from replicas first (docs/similarity.md)."""
+        if depth > 64:
             return None
+        try:
+            with open(self._delta_path_str(digest), "rb") as f:
+                blob = f.read()
+        except FileNotFoundError:
+            return None
+        try:
+            base_d, _out_len = _parse_delta_header(blob)
+        except ValueError:
+            self._drop_delta(digest)
+            return None
+        try:
+            with open(self._path_str(base_d), "rb") as f:
+                base = f.read()
+        except FileNotFoundError:
+            base = self._get_delta(base_d, depth + 1)
+        if base is None:
+            if self.sim is not None:
+                self.sim.note_missing_base()
+            return None
+        try:
+            out = _apply_delta(blob, base)
+        except ValueError:
+            self._drop_delta(digest)
+            return None
+        if sha256_hex(out) != digest:
+            self._drop_delta(digest)
+            return None
+        if depth == 0 and self.sim is not None \
+                and self.sim.note_delta_read(digest):
+            self._rematerialize(digest, out)
+        return out
 
     def delete(self, digest: str) -> bool:
         p = self._path_str(digest)
@@ -311,6 +673,16 @@ class ChunkStore:
             # raises and neither gauge moves — same story as put's
             # exactly-one-True link race
             with self._index_mu:
+                if self._deltas_possible():
+                    # pinned base: resident deltas reconstruct through
+                    # this digest — refused until the dependents die or
+                    # re-materialize. Checked INSIDE the ordering mutex:
+                    # _put_delta registers its pin under the same lock,
+                    # so a racing delta write either sees the base
+                    # survive or rolls itself back, never a broken chain
+                    with self._delta_mu:
+                        if self._delta_refs.get(digest, 0) > 0:
+                            return False
                 size = os.path.getsize(p)
                 if self.index is not None:
                     # recorded BEFORE the unlink (written through, not
@@ -329,6 +701,8 @@ class ChunkStore:
                 self.index.maybe_flush()   # outside the ordering mutex
             return True
         except FileNotFoundError:
+            if self._deltas_possible():
+                return self._drop_delta(digest)
             return False
 
     def count(self) -> int:
@@ -362,13 +736,37 @@ class ChunkStore:
         for sub in sorted(self.root.iterdir()) if self.root.is_dir() else []:
             if sub.is_dir():
                 # filter strays (e.g. crash-leaked .tmp-* from _atomic_write)
+                # — which also skips the deltas/ fan-out (2-hex names)
                 out.extend(sorted(
                     p.name for p in sub.iterdir()
                     if len(p.name) == 64 and set(p.name) <= hexdigits))
+        if self._deltas_possible():
+            seen = set(out)
+            droot = Path(self._deltas_root)
+            for sub in sorted(droot.iterdir()) if droot.is_dir() else []:
+                if sub.is_dir():
+                    out.extend(sorted(
+                        p.name for p in sub.iterdir()
+                        if len(p.name) == 64 and set(p.name) <= hexdigits
+                        and p.name not in seen))
         return out
 
     def total_bytes(self) -> int:
-        return sum((self.root / d[:2] / d).stat().st_size for d in self.digests())
+        total = 0
+        for d in self.digests():
+            try:
+                total += os.path.getsize(self._path_str(d))
+            # delta-stored (or deleted mid-scan): count the delta
+            # file's on-disk bytes instead — this gauge measures
+            # footprint, not logical size
+            except OSError:  # dfslint: ignore[DFS007]
+                try:
+                    total += os.path.getsize(self._delta_path_str(d))
+                # vanished between the listing and the stat: a racing
+                # delete won — the ordinary census-race outcome
+                except OSError:  # dfslint: ignore[DFS007]
+                    pass
+        return total
 
     def bytes_total(self) -> int:
         """CAS payload bytes, O(1) after the first call — the capacity
@@ -422,9 +820,16 @@ class ChunkStore:
             truncated = False
             for prefix in sorted(set(list_prefixes)):
                 sub = self.root / prefix
-                names = sorted(
+                pool = {
                     d for d in (os.listdir(sub) if sub.is_dir() else [])
-                    if len(d) == 64 and set(d) <= hexdigits)
+                    if len(d) == 64 and set(d) <= hexdigits}
+                if self._deltas_possible():
+                    dsub = Path(self._deltas_root) / prefix
+                    pool.update(
+                        d for d in
+                        (os.listdir(dsub) if dsub.is_dir() else [])
+                        if len(d) == 64 and set(d) <= hexdigits)
+                names = sorted(pool)
                 if len(names) > list_cap:
                     names = names[:list_cap]
                     truncated = True
@@ -456,6 +861,32 @@ class ChunkStore:
                 buckets[sub.name] = b
                 total_n += b[0]
                 total_b += b[1]
+        if self._deltas_possible():
+            droot = Path(self._deltas_root)
+            for sub in sorted(droot.iterdir()) if droot.is_dir() else []:
+                if not sub.is_dir() or len(sub.name) != self.PREFIX_HEX \
+                        or not set(sub.name) <= hexdigits:
+                    continue
+                for p in sub.iterdir():
+                    d = p.name
+                    if len(d) != 64 or not set(d) <= hexdigits:
+                        continue
+                    if os.path.isfile(self._path_str(d)):
+                        continue   # mid-re-materialize: raw pass counted it
+                    if not self._chain_resolves(d):
+                        continue   # broken chain: not reconstructible —
+                        # absent for census purposes (scrub heals first)
+                    try:
+                        size = p.stat().st_size
+                    # same stat-vs-delete race as the raw pass
+                    except OSError:  # dfslint: ignore[DFS007]
+                        continue
+                    b = buckets.setdefault(sub.name, [0, 0, 0])
+                    b[0] += 1
+                    b[1] += size
+                    b[2] ^= self.digest_stamp(d)
+                    total_n += 1
+                    total_b += size
         with self._count_lock:
             # unconditional: the full scan is ground truth at scan time,
             # so every census/df heals whatever skew the gauges carried
@@ -481,6 +912,11 @@ class ChunkStore:
         dirs = [sub for sub in
                 (self.root.iterdir() if self.root.is_dir() else [])
                 if sub.is_dir()]
+        if self._deltas_possible():
+            droot = Path(self._deltas_root)
+            dirs.extend(sub for sub in
+                        (droot.iterdir() if droot.is_dir() else [])
+                        if sub.is_dir())
         return _sweep_tmp_files(dirs, max_age_s)
 
 
@@ -680,6 +1116,20 @@ class NodeStore:
         live: set[str] = set()
         for m in self.manifests.list():
             live.update(m.all_digests())   # incl. erasure parity chunks
+        # delta-base pinning (similarity plane): a live delta-stored
+        # chunk reconstructs through its base chain, so every base
+        # under a live delta is live too — GC'ing one would break reads
+        # of a still-referenced file. chunks.delete()'s pin refusal
+        # backs this up; expanding the live set here keeps the dead
+        # list honest instead of relying on refusals.
+        for d in list(live):
+            cur = d
+            for _ in range(64):
+                base = self.chunks.delta_base(cur)
+                if base is None:
+                    break
+                live.add(base)
+                cur = base
         cutoff = time.time() - min_age_s
         dead = []
         for d in self.chunks.digests():
@@ -687,14 +1137,41 @@ class NodeStore:
                 continue
             if min_age_s > 0:
                 try:
-                    if self.chunks._path(d).stat().st_mtime > cutoff:
-                        continue
+                    st = self.chunks._path(d).stat()
                 except FileNotFoundError:
+                    try:   # delta-stored: age-gate on the delta file
+                        st = os.stat(self.chunks._delta_path_str(d))
+                    except FileNotFoundError:
+                        continue
+                if st.st_mtime > cutoff:
                     continue
             dead.append(d)
-        for d in dead:
-            self.chunks.delete(d)
+        # dead deltas first: deleting one releases its base pin, so a
+        # dead base in the SAME pass is reclaimable instead of being
+        # refused until the next cycle
+        dead.sort(key=lambda d: self.chunks.delta_base(d) is None)
+        sim = self.chunks.sim
+        if sim is not None and dead:
+            # crash seam: live + pinned sets computed, nothing deleted
+            # yet — a kill here must lose no reconstructible chunk
+            sim.maybe_crash("sim.before_base_gc")
+        deleted: list[str] = []
+        pending = dead
+        while pending:
+            # fixpoint over pin refusals: a chain of dead deltas
+            # releases its pins one link per sweep — retry until a
+            # sweep frees nothing (then the survivors are pinned by
+            # LIVE deltas, i.e. not actually dead)
+            nxt = []
+            for d in pending:
+                if self.chunks.delete(d):
+                    deleted.append(d)
+                elif self.chunks.delta_pinned(d):
+                    nxt.append(d)
+            if len(nxt) == len(pending):
+                break
+            pending = nxt
         # hour-gated: never races a live put or manifest write
         self.chunks.sweep_tmp()
         self.manifests.sweep_tmp()
-        return dead
+        return deleted

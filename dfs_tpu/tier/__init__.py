@@ -247,6 +247,33 @@ class TierPlane:
         self.credit_stall_s = 0.0
         self.last_scan_at = 0.0           # wall clock of last scan END
         self.last_progress_at = time.monotonic()  # doctor tier_stall
+        # re-demotion hysteresis (redemote_cooldown_s): wall-clock stamp
+        # of each file's last PROMOTION — a file flapping around the
+        # promote_reads threshold must not churn encode/decode every
+        # scan. In-memory only: a restart forgets the stamps, which errs
+        # toward one extra demote-eligible window (the cheap direction).
+        self.promoted_at: dict[str, float] = {}
+
+    def note_promoted(self, file_id: str) -> None:
+        self.promoted_at[file_id] = time.time()
+        # bounded like the ledger: drop the oldest stamps once past the
+        # ledger's entry budget — a forgotten stamp only re-opens
+        # demote eligibility early, never breaks correctness
+        while len(self.promoted_at) > self.cfg.ledger_entries:
+            self.promoted_at.pop(next(iter(self.promoted_at)))
+
+    def in_redemote_cooldown(self, file_id: str,
+                             now: float | None = None) -> bool:
+        """True while ``file_id`` was promoted less than
+        ``redemote_cooldown_s`` ago — the demotion scan skips it
+        (0 = historical behavior, no hysteresis)."""
+        if self.cfg.redemote_cooldown_s <= 0:
+            return False
+        at = self.promoted_at.get(file_id)
+        if at is None:
+            return False
+        now = time.time() if now is None else now
+        return (now - at) < self.cfg.redemote_cooldown_s
 
     def note_credit_stall(self, s: float) -> None:
         self.credit_stall_s += s

@@ -39,7 +39,8 @@ REQUIRED_ARTIFACTS = ("OBS_r09.json", "WIRE_r10.json", "OBS2_r11.json",
                       "CENSUS_r12.json", "CHAOS_r13.json",
                       "REBALANCE_r14.json", "CDC_SHARD_r15.json",
                       "DEDUP_INDEX_r16.json", "OVERLOAD_r18.json",
-                      "CLIENT_r19.json", "TIER_r20.json")
+                      "CLIENT_r19.json", "TIER_r20.json",
+                      "SIM_r21.json")
 
 
 def _tracked_files(root: Path) -> list[Path]:

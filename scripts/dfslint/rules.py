@@ -313,7 +313,7 @@ def check_digest_boundary(project: Project) -> Iterator[Finding]:
 _CLI_CLASSES = ("NodeConfig", "ServeConfig", "IngestConfig", "ObsConfig",
                 "FragmenterConfig", "CensusConfig", "DurabilityConfig",
                 "ChaosConfig", "RingConfig", "IndexConfig", "TierConfig",
-                "ClientConfig")
+                "SimConfig", "ClientConfig")
 # config field -> /metrics key that surfaces it, per stats function.
 # "cas" carries cas_io_threads as its nested workers count
 # (store/aio.py stats()).
@@ -404,7 +404,21 @@ _TIER_METRIC_KEYS = {"enabled": "enabled",
                      "demote_credit_bytes": "demoteCreditBytes",
                      "half_life_s": "halfLifeS",
                      "promote_reads": "promoteReads",
+                     "redemote_cooldown_s": "redemoteCooldownS",
                      "ledger_entries": "ledgerEntries"}
+
+# similarity-compression knobs surface under /metrics "sim"
+# (node/runtime.py sim_stats())
+_SIM_METRIC_KEYS = {"enabled": "enabled",
+                    "sketch_size": "sketchSize",
+                    "bands": "bands",
+                    "shingle_bytes": "shingleBytes",
+                    "max_candidates": "maxCandidates",
+                    "min_chunk_bytes": "minChunkBytes",
+                    "min_savings_frac": "minSavingsFrac",
+                    "max_delta_depth": "maxDeltaDepth",
+                    "devices": "devices",
+                    "rematerialize_reads": "rematerializeReads"}
 
 # smart-client knobs surface in SmartClient.stats()
 # (dfs_tpu/client/smart.py) — the SDK's config echo plays the same
@@ -583,6 +597,7 @@ def check_config_drift(project: Project) -> Iterator[Finding]:
             (runtime, "index_stats", "IndexConfig",
              _INDEX_METRIC_KEYS),
             (runtime, "tier_stats", "TierConfig", _TIER_METRIC_KEYS),
+            (runtime, "sim_stats", "SimConfig", _SIM_METRIC_KEYS),
             (client_pkg, "stats", "ClientConfig",
              _CLIENT_METRIC_KEYS)):
         if src is None or src.tree is None or cls not in classes:

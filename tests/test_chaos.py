@@ -501,9 +501,11 @@ def test_kill9_at_every_crash_point_then_restart(tmp_path, rng):
     seq = 0
     # demote.* points fire in the tiering worker, not the upload path —
     # a node armed with one would never crash here (covered by the
-    # dedicated kill-9 tests in tests/test_tiering.py instead)
+    # dedicated kill-9 tests in tests/test_tiering.py instead); sim.*
+    # points need --sim, which this harness leaves off (covered by the
+    # bench_sim.py crash matrix and tests/test_sim.py)
     for point in sorted(p for p in CRASH_POINTS
-                        if not p.startswith("demote.")):
+                        if not p.startswith(("demote.", "sim."))):
         # phase 1: healthy boot — ack one file
         proc = subprocess.Popen(
             _serve_argv(http_port, internal_port, data_root),
@@ -578,7 +580,8 @@ def test_kill9_at_every_crash_point_then_restart(tmp_path, rng):
             proc.terminate()
             proc.wait(timeout=10)
     assert len(acked) == len(
-        [p for p in CRASH_POINTS if not p.startswith("demote.")])
+        [p for p in CRASH_POINTS
+         if not p.startswith(("demote.", "sim."))])
 
 
 def test_bench_chaos_tiny_smoke(tmp_path):

@@ -604,6 +604,58 @@ def test_dfs005_tier_fields_checked(tmp_path):
                            "dfs_tpu/node/runtime.py": runtime_ok}) == []
 
 
+def test_dfs005_sim_fields_checked(tmp_path):
+    """r21: SimConfig rides the same three DFS005 edges — a similarity
+    knob dropped from cmd_serve's constructor, and one whose /metrics
+    key vanishes from sim_stats(), must both be findings; the wired
+    fixture must be clean."""
+    cfg = (
+        "import dataclasses\n"
+        "@dataclasses.dataclass(frozen=True)\n"
+        "class SimConfig:\n"
+        "    enabled: bool = False\n"
+        "    max_delta_depth: int = 3\n")
+    cli_missing = (
+        "from dfs_tpu.config import SimConfig\n"
+        "def cmd_serve(args):\n"
+        "    return SimConfig(enabled=args.sim)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--sim', action='store_true')\n")
+    runtime_ok = (
+        "class S:\n"
+        "    def sim_stats(self):\n"
+        "        return {'enabled': False, 'maxDeltaDepth': 3}\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/cli/main.py": cli_missing,
+                            "dfs_tpu/node/runtime.py": runtime_ok})
+    assert rules_of(found) == ["DFS005"]
+    assert "SimConfig.max_delta_depth" in found[0].message
+
+    cli_ok = (
+        "from dfs_tpu.config import SimConfig\n"
+        "def cmd_serve(args):\n"
+        "    return SimConfig(enabled=args.sim,\n"
+        "                     max_delta_depth=args.sim_max_delta_depth)\n"
+        "def build_parser(sub):\n"
+        "    sub.add_argument('--sim', action='store_true')\n"
+        "    sub.add_argument('--sim-max-delta-depth', type=int,\n"
+        "                     default=3)\n")
+    runtime_missing_key = (
+        "class S:\n"
+        "    def sim_stats(self):\n"
+        "        return {'enabled': False}\n")
+    found = lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                            "dfs_tpu/cli/main.py": cli_ok,
+                            "dfs_tpu/node/runtime.py":
+                            runtime_missing_key})
+    assert rules_of(found) == ["DFS005"]
+    assert "maxDeltaDepth" in found[0].message
+
+    assert lint(tmp_path, {"dfs_tpu/config.py": cfg,
+                           "dfs_tpu/cli/main.py": cli_ok,
+                           "dfs_tpu/node/runtime.py": runtime_ok}) == []
+
+
 def test_dfs005_deadline_hedge_fields_checked(tmp_path):
     """r18: the ServeConfig deadline/hedge fields ride the same three
     DFS005 edges — a deadline/hedge knob dropped from cmd_serve's

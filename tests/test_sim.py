@@ -1,0 +1,601 @@
+"""Similarity compression plane (dfs_tpu/sim, docs/similarity.md).
+
+Layers of coverage:
+
+- SKETCH KERNEL: the sharded min-hash step is byte-identical to the
+  NumPy oracle across adversarial geometries (empty, one-byte,
+  sub-window, exact-window, ragged-tail, multi-batch device spans) and
+  in the devices=64 degraded fallback — mirroring the
+  tests/test_sharded_ingest.py identity matrix.
+- DELTA CODEC: DSD1 round-trips (edit, insert, truncate, disjoint),
+  header parsing, and structural-damage rejection.
+- BAND LOG: replay, the kill--9 torn tail (truncate at the first bad
+  record), and mid-log CRC damage degrading to a shorter prefix.
+- STORE: transparent delta write/read through the ChunkStore seam with
+  sha256 verification, base pinning against delete, pin release when
+  the referencing chunk dies (the GC satellite regression), depth cap,
+  and re-materialize-on-hot.
+- DEFAULT-OFF IDENTITY: a sim-less store/node builds no plane, no
+  deltas tree, and serves byte-identical to every pre-r21 release.
+- BENCH: ``bench_sim.py --tiny`` subprocess smoke + the committed
+  SIM_r21.json schema/gate lock.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dfs_tpu.config import (CDCParams, CensusConfig, ClusterConfig,
+                            NodeConfig, PeerAddr, SimConfig)
+from dfs_tpu.sim.bands import BandIndex
+from dfs_tpu.sim.delta import (HEADER_BYTES, apply_delta, is_delta,
+                               make_delta, parse_header)
+from dfs_tpu.sim.sketch import (EMPTY_LANE, SimSketcher, band_keys,
+                                lane_constants, sketch_np)
+from dfs_tpu.store.cas import ChunkStore, NodeStore
+from dfs_tpu.utils.hashing import sha256_hex
+
+REPO = Path(__file__).resolve().parent.parent
+WINDOW = 4096        # small compile window: seconds, same code paths
+
+# store-level similarity knobs: tiny chunks, oracle sketches
+SIM_NOW = SimConfig(enabled=True, min_chunk_bytes=64, devices=0)
+
+
+def _sketcher(devices: int = 4, **kw) -> SimSketcher:
+    return SimSketcher(SimConfig(enabled=True, devices=devices),
+                       window_bytes=WINDOW, **kw)
+
+
+def _mutate(data: bytes, at: int, ins: bytes) -> bytes:
+    return data[:at] + ins + data[at + 1:]
+
+
+# ------------------------------------------------------------------ #
+# sketch kernel == NumPy oracle
+# ------------------------------------------------------------------ #
+
+@pytest.mark.parametrize("size", [0, 1, 7, 100, 5000, WINDOW,
+                                  WINDOW + 1, 3 * WINDOW - 7])
+def test_sketch_kernel_matches_oracle(size):
+    """sketch_many through the 4-device mesh == the per-chunk host
+    oracle for empty, shorter-than-one-shingle, sub-window,
+    exact-window and ragged (> window -> oracle fallback) chunks."""
+    rng = np.random.default_rng(210)
+    skt = _sketcher(devices=4)
+    data = rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+    got = skt.sketch_many([data])
+    want = sketch_np(data, skt.cfg.sketch_size, skt.cfg.shingle_bytes,
+                     skt.lanes_a, skt.lanes_b)
+    assert not skt._unavailable
+    assert np.array_equal(got[0], want), f"size {size} diverged"
+    if size < skt.cfg.shingle_bytes:
+        assert (got[0] == EMPTY_LANE).all()
+
+
+def test_sketch_batch_spans_devices_and_mixes_ragged():
+    """One batch wider than the mesh: chunks ride the dp axis one per
+    device across THREE device-span borders, with ragged chunks (longer
+    than the compile window) interleaved mid-batch on the oracle path —
+    every lane byte-identical to the per-chunk oracle, in order."""
+    rng = np.random.default_rng(211)
+    skt = _sketcher(devices=4)
+    sizes = [100, WINDOW, 2 * WINDOW + 5, 300, WINDOW - 1, 0,
+             5 * WINDOW, 2048, WINDOW, 77, 4000, WINDOW // 2, 1]
+    datas = [rng.integers(0, 256, size=s, dtype=np.uint8).tobytes()
+             for s in sizes]
+    got = skt.sketch_many(datas)
+    assert not skt._unavailable
+    for i, d in enumerate(datas):
+        want = sketch_np(d, skt.cfg.sketch_size, skt.cfg.shingle_bytes,
+                         skt.lanes_a, skt.lanes_b)
+        assert np.array_equal(got[i], want), f"batch slot {i} diverged"
+
+
+def test_sketch_degraded_environment_falls_back():
+    """More devices configured than visible: sketches must still come
+    out, through the host oracle, byte-identical."""
+    rng = np.random.default_rng(212)
+    skt = _sketcher(devices=64)
+    datas = [rng.integers(0, 256, size=s, dtype=np.uint8).tobytes()
+             for s in (3000, WINDOW, 10)]
+    got = skt.sketch_many(datas)
+    assert skt._unavailable
+    for i, d in enumerate(datas):
+        want = sketch_np(d, skt.cfg.sketch_size, skt.cfg.shingle_bytes,
+                         skt.lanes_a, skt.lanes_b)
+        assert np.array_equal(got[i], want)
+
+
+def test_sketch_similarity_and_band_keys():
+    """The LSH contract: similar chunks agree on most lanes (so share a
+    band); unrelated chunks don't; featureless chunks have no keys; and
+    the lane constants are deterministic across processes (sketches
+    must agree cluster-wide)."""
+    rng = np.random.default_rng(213)
+    skt = _sketcher(devices=0)
+    base = rng.integers(0, 256, size=8192, dtype=np.uint8).tobytes()
+    near = _mutate(base, 4000, b"XY")
+    far = rng.integers(0, 256, size=8192, dtype=np.uint8).tobytes()
+    s_base, s_near, s_far = skt.sketch_many([base, near, far])
+    kb = band_keys(s_base, 4)
+    assert len(kb) == 4
+    assert set(kb) & set(band_keys(s_near, 4)), \
+        "a 2-byte edit must leave shared bands"
+    assert not set(kb) & set(band_keys(s_far, 4))
+    assert band_keys(np.full(16, EMPTY_LANE, np.uint32), 4) == []
+    a1, b1 = lane_constants(16)
+    a2, b2 = lane_constants(16)
+    assert np.array_equal(a1, a2) and np.array_equal(b1, b2)
+    assert (a1 % 2 == 1).all()     # bijective lane permutations
+
+
+# ------------------------------------------------------------------ #
+# DSD1 delta codec
+# ------------------------------------------------------------------ #
+
+def test_delta_codec_roundtrips():
+    rng = np.random.default_rng(214)
+    base = rng.integers(0, 256, size=20_000, dtype=np.uint8).tobytes()
+    cases = [
+        base,                                   # identical
+        _mutate(base, 9999, b"EDIT"),           # small edit
+        base[:5000] + b"INSERTED" + base[5000:],  # insertion
+        base[3000:17000],                       # truncation both ends
+        base[10000:] + base[:10000],            # rotation
+        rng.integers(0, 256, size=20_000, dtype=np.uint8).tobytes(),
+        b"",                                    # empty target
+        b"short",
+    ]
+    d0 = sha256_hex(base)
+    for target in cases:
+        blob = make_delta(d0, base, target)
+        assert is_delta(blob)
+        b_hex, out_len = parse_header(blob)
+        assert b_hex == d0 and out_len == len(target)
+        assert apply_delta(blob, base) == target
+    # similar targets compress: far below raw, far below the 50% bar
+    blob = make_delta(d0, base, _mutate(base, 9999, b"EDIT"))
+    assert len(blob) < len(base) // 4
+
+
+def test_delta_codec_rejects_structural_damage():
+    base = b"A" * 4096
+    blob = make_delta(sha256_hex(base), base, b"A" * 2048 + b"B" * 2048)
+    with pytest.raises(ValueError):
+        parse_header(b"XXXX" + blob[4:])        # bad magic
+    with pytest.raises(ValueError):
+        apply_delta(blob[:HEADER_BYTES + 3], base)   # torn op
+    with pytest.raises(ValueError):
+        apply_delta(blob + b"\x07", base)       # unknown op kind
+    # a copy op reaching past the base end must not serve junk
+    bad = bytearray(make_delta(sha256_hex(base), base, base))
+    off = HEADER_BYTES + 1
+    struct.pack_into(">II", bad, off, len(base) - 4, 4096)
+    with pytest.raises(ValueError):
+        apply_delta(bytes(bad), base)
+
+
+# ------------------------------------------------------------------ #
+# crash-safe band log
+# ------------------------------------------------------------------ #
+
+def test_band_log_replay_and_torn_tail(tmp_path):
+    """kill -9 mid-append leaves a torn tail; replay truncates at the
+    first bad record and every surviving add still resolves."""
+    idx = BandIndex(tmp_path)
+    idx.add("aa" * 32, [1, 2])
+    idx.add("bb" * 32, [2, 3])
+    idx.close()
+    with open(tmp_path / "bands.log", "ab") as fh:
+        fh.write(b"\x00" * 17)                  # torn mid-record
+    idx2 = BandIndex(tmp_path)
+    assert idx2.replayed == 4 and idx2.truncated == 17
+    assert idx2.lookup([2]) == ["bb" * 32, "aa" * 32]   # newest first
+    # the truncate really happened: a fresh add lands on a record
+    # boundary and survives a third replay
+    idx2.add("cc" * 32, [3])
+    idx2.close()
+    idx3 = BandIndex(tmp_path)
+    assert idx3.lookup([3]) == ["cc" * 32, "bb" * 32]
+    idx3.close()
+
+
+def test_band_log_mid_file_damage_degrades(tmp_path):
+    idx = BandIndex(tmp_path)
+    for i in range(4):
+        idx.add(f"{i:02d}" * 32, [i])
+    idx.close()
+    blob = bytearray((tmp_path / "bands.log").read_bytes())
+    blob[50] ^= 0xFF                            # corrupt record 1
+    (tmp_path / "bands.log").write_bytes(blob)
+    idx2 = BandIndex(tmp_path)
+    # replay stops at the damage: record 0 survives, the rest is gone
+    # (the chunk files are ground truth; the index is an optimization)
+    assert idx2.replayed == 1
+    assert idx2.lookup([0]) == ["00" * 32]
+    assert idx2.lookup([1, 2, 3]) == []
+    idx2.close()
+
+
+def test_band_index_bounds_candidates(tmp_path):
+    idx = BandIndex(tmp_path, per_key=2)
+    for i in range(5):
+        idx.add(f"{i:02d}" * 32, [7])
+    assert idx.lookup([7]) == ["04" * 32, "03" * 32]    # newest 2 win
+    assert idx.lookup([7], exclude="04" * 32) == ["03" * 32]
+    idx.close()
+
+
+# ------------------------------------------------------------------ #
+# ChunkStore delta seam (helpers)
+# ------------------------------------------------------------------ #
+
+def _sim_store(root: Path, cfg: SimConfig = SIM_NOW):
+    from dfs_tpu.sim import SimPlane
+
+    cs = ChunkStore(root / "chunks")
+    cs.sim = SimPlane(cfg, root / "sim")
+    return cs
+
+
+def _put(cs: ChunkStore, data: bytes) -> str:
+    d = sha256_hex(data)
+    cs.put(d, data)
+    return d
+
+
+def test_store_delta_write_read_verify(tmp_path):
+    """A similar chunk stores as base+patch, reads back byte-identical
+    through the transparent reconstruct (sha256-verified), and the
+    on-disk footprint is the patch, not the chunk."""
+    cs = _sim_store(tmp_path)
+    rng = np.random.default_rng(215)
+    base = rng.integers(0, 256, size=16_384, dtype=np.uint8).tobytes()
+    near = _mutate(base, 8000, b"!")
+    d0, d1 = _put(cs, base), _put(cs, near)
+    assert cs.delta_base(d1) == d0 and cs.delta_count() == 1
+    assert cs.get(d1) == near and cs.get(d0) == base
+    blob = Path(cs._delta_path_str(d1)).read_bytes()
+    assert is_delta(blob) and len(blob) < len(near) // 2
+    assert cs.has(d1) and d1 in cs.digests()
+    # census sees the delta-resident digest: drill-down lists it, and
+    # the full scan counts its (patch-sized) footprint
+    inv = cs.inventory(list_prefixes=[d1[:2]])
+    assert d1 in inv["listed"][d1[:2]]
+    full = cs.inventory()
+    assert full["chunks"] == 2
+    assert full["bytes"] == len(base) + len(blob)
+    cs.sim.close()
+
+
+def test_store_delta_accepts_bytearray_payload(tmp_path):
+    """The peer replication path hands ZERO-COPY bytearray wire slices
+    to put(); the anchor-table encoder hashes target slices, so the
+    plane must materialize them — a bytearray near-duplicate must
+    delta-encode, not throw 'unhashable type' (found live: replication
+    to peers 500'd below quorum on every sim-eligible chunk)."""
+    cs = _sim_store(tmp_path)
+    rng = np.random.default_rng(219)
+    base = rng.integers(0, 256, size=16_384, dtype=np.uint8).tobytes()
+    near = _mutate(base, 8000, b"!")
+    d0 = sha256_hex(base)
+    cs.put(d0, bytearray(base))          # raw path: bytearray in
+    d1 = sha256_hex(near)
+    cs.put(d1, bytearray(near))          # sim path: encodes vs d0
+    assert cs.delta_base(d1) == d0 and cs.delta_count() == 1
+    assert cs.get(d0) == base and cs.get(d1) == near
+    cs.sim.close()
+
+
+def test_store_pins_base_until_dependents_die(tmp_path):
+    """The delete-safety satellite at the store layer: a base with a
+    live delta dependent refuses delete(); dropping the dependent
+    releases the pin."""
+    cs = _sim_store(tmp_path)
+    rng = np.random.default_rng(216)
+    base = rng.integers(0, 256, size=16_384, dtype=np.uint8).tobytes()
+    d0 = _put(cs, base)
+    d1 = _put(cs, _mutate(base, 100, b"x"))
+    assert cs.delta_base(d1) == d0
+    assert cs.delta_pinned(d0)
+    assert cs.delete(d0) is False           # pinned: refused
+    assert cs.get(d1) is not None
+    assert cs.delete(d1) is True            # dependent dies ...
+    assert not cs.delta_pinned(d0)
+    assert cs.delete(d0) is True            # ... pin released
+    assert cs.delta_count() == 0
+    cs.sim.close()
+
+
+def test_gc_releases_pin_when_referencing_file_deleted(tmp_path):
+    """The ISSUE regression: file2's chunk is a delta against file1's
+    chunk. GC keeps both while both manifests live; deleting file2
+    releases the pin so a later GC reclaims base+delta in order."""
+    from dfs_tpu.meta.manifest import ChunkRef, Manifest
+    from dfs_tpu.sim import SimPlane
+
+    ns = NodeStore(tmp_path, node_id=1)
+    _plane = ns.chunks.sim = SimPlane(SIM_NOW, ns.root / "sim")
+    rng = np.random.default_rng(217)
+    base = rng.integers(0, 256, size=16_384, dtype=np.uint8).tobytes()
+    near = _mutate(base, 5000, b"~")
+    d0, d1 = _put(ns.chunks, base), _put(ns.chunks, near)
+    assert ns.chunks.delta_base(d1) == d0
+
+    def mk(name: str, data: bytes, digest: str) -> Manifest:
+        return Manifest(file_id=sha256_hex(data), name=name,
+                        size=len(data), fragmenter="fixed",
+                        chunks=(ChunkRef(index=0, offset=0,
+                                         length=len(data),
+                                         digest=digest),))
+
+    m1, m2 = mk("f1", base, d0), mk("f2", near, d1)
+    ns.manifests.save(m1)
+    ns.manifests.save(m2)
+    assert ns.gc(min_age_s=0.0) == []       # both referenced: no-op
+    # deleting the REFERENCING file releases the pin: the delta dies,
+    # the base survives on its own manifest, unpinned
+    ns.manifests.delete(m2.file_id)
+    assert ns.gc(min_age_s=0.0) == [d1]
+    assert not ns.chunks.delta_pinned(d0)
+    assert ns.chunks.get(d0) == base
+    ns.manifests.delete(m1.file_id)
+    assert ns.gc(min_age_s=0.0) == [d0]
+    assert ns.chunks.delta_count() == 0
+
+    # the LIVE SET expands through base chains: a base referenced by NO
+    # manifest of its own survives while a live file's delta needs it —
+    # and the fixpoint loop reclaims the whole chain once that file dies
+    base2 = rng.integers(0, 256, size=16_384, dtype=np.uint8).tobytes()
+    near2 = _mutate(base2, 900, b"^")
+    e0, e1 = _put(ns.chunks, base2), _put(ns.chunks, near2)
+    assert ns.chunks.delta_base(e1) == e0
+    m3 = mk("f3", near2, e1)
+    ns.manifests.save(m3)
+    assert ns.gc(min_age_s=0.0) == []       # e0 live via the chain
+    assert ns.chunks.get(e1) == near2
+    ns.manifests.delete(m3.file_id)
+    assert sorted(ns.gc(min_age_s=0.0)) == sorted([e0, e1])
+    assert ns.chunks.delta_count() == 0
+    _plane.close()
+
+
+def test_store_depth_cap_and_rematerialize(tmp_path):
+    """Chains stop at max_delta_depth, and a hot delta re-materializes
+    to raw after rematerialize_reads reconstructions — byte-identical
+    before, during and after."""
+    cfg = SimConfig(enabled=True, min_chunk_bytes=64, devices=0,
+                    max_delta_depth=2, rematerialize_reads=2)
+    cs = _sim_store(tmp_path, cfg)
+    rng = np.random.default_rng(218)
+    gen = [rng.integers(0, 256, size=16_384, dtype=np.uint8).tobytes()]
+    for i in range(4):
+        gen.append(_mutate(gen[-1], 2000 + i, bytes([i])))
+    ds = [_put(cs, g) for g in gen]
+    depths = [cs.delta_depth(d) for d in ds]
+    assert max(depths) <= 2 and depths[0] == 0
+    assert any(x > 0 for x in depths)
+    for d, g in zip(ds, gen):
+        assert cs.get(d) == g
+    cs.sim.close()
+
+    # re-materialize on hot, isolated to one base+delta pair (the
+    # chain store above reads deltas as encode CANDIDATES during put,
+    # which counts toward the same hysteresis — by design)
+    cs2 = _sim_store(tmp_path / "re", cfg)
+    base = rng.integers(0, 256, size=16_384, dtype=np.uint8).tobytes()
+    near = _mutate(base, 4444, b"#")
+    d0, d1 = _put(cs2, base), _put(cs2, near)
+    assert cs2.delta_base(d1) == d0
+    assert cs2.get(d1) == near               # read 1: still a delta
+    assert cs2.delta_base(d1) == d0
+    assert cs2.get(d1) == near               # read 2: re-materialize
+    assert cs2.delta_base(d1) is None
+    assert os.path.isfile(cs2._path_str(d1))
+    assert not os.path.isfile(cs2._delta_path_str(d1))
+    assert cs2.get(d1) == near
+    assert not cs2.delta_pinned(d0)          # pin went with the delta
+    assert cs2.delete(d0)
+    cs2.sim.close()
+
+
+def test_store_restart_primes_pins_without_plane(tmp_path):
+    """The delta files ARE the log: a plane-less restart (sim turned
+    off) still reconstructs reads and still honors pins."""
+    cs = _sim_store(tmp_path)
+    rng = np.random.default_rng(219)
+    base = rng.integers(0, 256, size=16_384, dtype=np.uint8).tobytes()
+    near = _mutate(base, 700, b"*")
+    d0, d1 = _put(cs, base), _put(cs, near)
+    assert cs.delta_base(d1) == d0
+    cs.sim.close()
+    cs2 = ChunkStore(tmp_path / "chunks")    # no sim plane attached
+    assert cs2.delta_base(d1) == d0
+    assert cs2.get(d1) == near
+    assert cs2.delete(d0) is False           # pin survived the restart
+    assert cs2.delete(d1) and cs2.delete(d0)
+
+
+def test_store_corrupt_delta_treated_as_corrupt_chunk(tmp_path):
+    """Structural damage to a delta file reads as a missing chunk (the
+    corrupt-raw discipline: drop, let repair re-replicate) — never as
+    wrong bytes."""
+    cs = _sim_store(tmp_path)
+    rng = np.random.default_rng(220)
+    base = rng.integers(0, 256, size=16_384, dtype=np.uint8).tobytes()
+    d0 = _put(cs, base)
+    d1 = _put(cs, _mutate(base, 3000, b"&"))
+    p = Path(cs._delta_path_str(d1))
+    blob = bytearray(p.read_bytes())
+    blob[HEADER_BYTES:] = b"\x09" * 8        # unknown op stream
+    p.write_bytes(blob)
+    assert cs.get(d1) is None                # dropped, not served
+    assert not cs.delta_pinned(d0)           # pin released with it
+    cs.sim.close()
+
+
+# ------------------------------------------------------------------ #
+# default-off identity + node wiring
+# ------------------------------------------------------------------ #
+
+def test_default_off_store_identity(tmp_path):
+    """A store without a plane writes the exact pre-r21 tree: raw
+    chunk files only, no deltas/ directory, byte-identical serves."""
+    assert SimConfig() == SimConfig(enabled=False)
+    cs = ChunkStore(tmp_path / "chunks")
+    data = b"identity" * 4000
+    d = _put(cs, data)
+    assert cs.get(d) == data
+    assert not (tmp_path / "chunks" / "deltas").exists()
+    assert [p.name for p in sorted((tmp_path / "chunks").iterdir())] \
+        == [d[:2]]
+    assert cs.delta_count() == 0 and cs.delta_base(d) is None
+
+
+def _mk_cluster(n: int, rf: int) -> ClusterConfig:
+    socks, ports = [], []
+    for _ in range(2 * n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    peers = tuple(PeerAddr(node_id=i + 1, host="127.0.0.1",
+                           port=ports[2 * i],
+                           internal_port=ports[2 * i + 1])
+                  for i in range(n))
+    return ClusterConfig(peers=peers, replication_factor=rf)
+
+
+def test_node_sim_plane_wiring(tmp_path):
+    """End to end on a real node: --sim-equivalent config builds the
+    plane, similar uploads delta-encode behind the CAS, downloads are
+    byte-identical, and /metrics "sim" mirrors config + counters. A
+    default node builds NO plane and reports {"enabled": False}."""
+    from dfs_tpu.node.runtime import StorageNodeServer
+
+    async def run() -> None:
+        cluster = _mk_cluster(1, rf=1)
+        p = cluster.peers[0]
+        cfg = NodeConfig(
+            node_id=1, cluster=cluster, data_root=tmp_path,
+            fragmenter="cdc",
+            cdc=CDCParams(min_size=2048, avg_size=8192, max_size=65536),
+            health_probe_s=0, census=CensusConfig(history_interval_s=0),
+            sim=SimConfig(enabled=True, min_chunk_bytes=1024, devices=0))
+        node = StorageNodeServer(cfg)
+        await node.start()
+        try:
+            assert node.sim is not None
+            rng = np.random.default_rng(221)
+            data = rng.integers(0, 256, size=120_000,
+                                dtype=np.uint8).tobytes()
+            near = _mutate(data, 60_000, b"@")
+            m1, _ = await node.upload(data, "f1.bin")
+            m2, _ = await node.upload(near, "f2.bin")
+            _, b1 = await node.download(m1.file_id)
+            _, b2 = await node.download(m2.file_id)
+            assert bytes(b1) == data and bytes(b2) == near
+            st = node.sim_stats()
+            assert st["enabled"] is True
+            assert st["sketched"] > 0
+            assert st["deltasWritten"] >= 1, \
+                "a near-duplicate upload must delta-encode"
+            assert st["deltaChunks"] >= 1
+            assert st["minChunkBytes"] == 1024   # config mirror
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
+
+    async def run_off() -> None:
+        cluster = _mk_cluster(1, rf=1)
+        cfg = NodeConfig(node_id=1, cluster=cluster,
+                         data_root=tmp_path / "off", fragmenter="cdc",
+                         cdc=CDCParams(min_size=2048, avg_size=8192,
+                                       max_size=65536),
+                         health_probe_s=0,
+                         census=CensusConfig(history_interval_s=0))
+        node = StorageNodeServer(cfg)
+        await node.start()
+        try:
+            assert node.sim is None
+            assert node.sim_stats() == {"enabled": False}
+            m, _ = await node.upload(b"plain" * 9000, "f.bin")
+            _, body = await node.download(m.file_id)
+            assert bytes(body) == b"plain" * 9000
+            assert not (node.store.root / "sim").exists()
+            assert not (node.store.root / "chunks" / "deltas").exists()
+        finally:
+            await node.stop()
+
+    asyncio.run(run_off())
+
+
+def test_sim_crash_points_registered():
+    from dfs_tpu.chaos import CRASH_POINTS
+    assert {"sim.after_delta_write", "sim.before_base_gc",
+            "sim.after_rematerialize"} <= set(CRASH_POINTS)
+
+
+# ------------------------------------------------------------------ #
+# bench smoke + committed artifact lock
+# ------------------------------------------------------------------ #
+
+def test_bench_sim_tiny_smoke(tmp_path):
+    """``bench_sim.py --tiny`` end to end: identity and crash gates
+    applied at tiny scale (perf reported, not gated), same schema the
+    committed SIM_r21.json embeds."""
+    out_path = tmp_path / "sim_tiny.json"
+    res = subprocess.run(
+        [sys.executable, str(REPO / "bench_sim.py"), "--tiny",
+         "--out", str(out_path)],
+        cwd=tmp_path, capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": str(REPO)})
+    assert res.returncode == 0, (
+        f"bench_sim --tiny failed:\n{res.stdout[-2000:]}"
+        f"\n{res.stderr[-4000:]}")
+    out = json.loads(out_path.read_text())
+    assert out["metric"] == "similarity_plane" and out["round"] == 21
+    assert out["ok"] is True
+    g = out["gates"]
+    assert g["corpus"]["ok"] and g["corpus"]["byteIdentity"]
+    assert g["corpus"]["simBytes"] < g["corpus"]["dedupBytes"]
+    assert g["sketch_scale"]["ok"]
+    assert g["crash"]["ok"]
+    assert set(g["crash"]["points"]) == {
+        "sim.after_delta_write", "sim.before_base_gc",
+        "sim.after_rematerialize"}
+    assert g["default_off"]["ok"]
+
+
+def test_committed_sim_artifact_schema():
+    """The committed SIM_r21.json is the FULL run: every gate applied
+    and green — the claims docs/similarity.md cites."""
+    art = json.loads((REPO / "SIM_r21.json").read_text())
+    assert art["metric"] == "similarity_plane" and art["round"] == 21
+    assert art["ok"] is True and art["mode"] == "full"
+    g = art["gates"]
+    assert g["corpus"]["gateApplied"] is True
+    assert g["corpus"]["simBytes"] < g["corpus"]["dedupBytes"]
+    assert g["corpus"]["savingsFrac"] >= 0.3
+    assert g["corpus"]["byteIdentity"] is True
+    assert g["sketch_scale"]["gateApplied"] is True
+    assert g["sketch_scale"]["scaleMaxDevices"] >= 1.7
+    assert g["sketch_scale"]["oracleIdentical"] is True
+    assert g["crash"]["ok"] is True
+    assert all(v["ok"] for v in g["crash"]["points"].values())
+    assert g["default_off"]["ok"] is True

@@ -154,6 +154,47 @@ def test_classify_byte_budget_knee_and_idle_floor():
 
 
 # ------------------------------------------------------------------ #
+# unit: re-demotion hysteresis (r21 flap guard)
+# ------------------------------------------------------------------ #
+
+def test_redemote_cooldown_blocks_flapping(tmp_path):
+    """A file promoted moments ago must not demote again inside
+    ``redemote_cooldown_s`` — the promote/demote flap around the
+    promote_reads threshold would otherwise churn an EC encode +
+    replica fan-out every scan. 0 (the default) keeps historical
+    no-hysteresis behavior bit-for-bit."""
+    from dfs_tpu.tier import TierPlane
+
+    cold = TierPlane(TierConfig(enabled=True), tmp_path / "a")
+    cold.note_promoted("f1")
+    # default cooldown 0: never in cooldown, even just-promoted
+    assert not cold.in_redemote_cooldown("f1", now=time.time())
+
+    plane = TierPlane(TierConfig(enabled=True, redemote_cooldown_s=60.0,
+                                 ledger_entries=256), tmp_path / "b")
+    # never-promoted files are always demotable
+    assert not plane.in_redemote_cooldown("f1", now=1000.0)
+    plane.note_promoted("f1")
+    at = plane.promoted_at["f1"]
+    # inside the window: the scan must skip it
+    assert plane.in_redemote_cooldown("f1", now=at + 59.9)
+    # window elapsed: demotable again
+    assert not plane.in_redemote_cooldown("f1", now=at + 60.1)
+    # the flap cycle: a re-promotion re-arms the cooldown
+    plane.note_promoted("f1")
+    assert plane.in_redemote_cooldown(
+        "f1", now=plane.promoted_at["f1"] + 1.0)
+
+    # bounded like the ledger: stamps past ledger_entries evict
+    # oldest-first (a forgotten stamp only re-opens eligibility early)
+    for i in range(300):
+        plane.note_promoted(f"bulk{i}")
+    assert len(plane.promoted_at) == 256
+    assert "f1" not in plane.promoted_at
+    assert not plane.in_redemote_cooldown("f1", now=at + 1.0)
+
+
+# ------------------------------------------------------------------ #
 # cluster helpers (the test_index idiom)
 # ------------------------------------------------------------------ #
 

@@ -94,6 +94,11 @@ CRASH_POINTS = frozenset({
     # ChunkStore re-materialize-on-hot: raw copy durable, the delta
     # file NOT yet unlinked — both representations present, raw wins
     "sim.after_rematerialize",
+    # BandIndex log compaction: compacted log written and fsynced at
+    # its temp name, bands.log NOT yet atomically replaced — replay
+    # must still serve the old complete log, and the next compaction
+    # unlinks the leftover temp
+    "sim.band_compact",
 })
 
 # knobs POST /chaos may change at runtime (everything except the

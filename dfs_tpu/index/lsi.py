@@ -384,7 +384,12 @@ class DigestIndex:
                 os.close(self._wal_fd)
                 self._wal_fd = None
             for p in list(self.root.iterdir()):
-                p.unlink(missing_ok=True)
+                # multi-step teardown without a crash point: a kill -9
+                # anywhere in the rebuild leaves at worst NO CURRENT —
+                # the next open starts empty and the stat backstop /
+                # scrub walk (which triggered this rebuild) re-feeds
+                # everything; the index is derived state by design
+                p.unlink(missing_ok=True)  # dfslint: ignore[DFS013]
             self._seq = 0
             self._rebuilds += 1
             recs = sorted((bytes.fromhex(d), _PRESENT)
@@ -531,7 +536,13 @@ class DigestIndex:
         self._seq += 1
         new_fd = os.open(self.root / self._wal_name,
                          os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o600)
-        self._write_current_locked()          # the commitment point
+        # multi-step sequence without its own crash point: every
+        # interruption window is covered by the docstring's ordering
+        # argument (before the replace the old CURRENT replays the old
+        # WAL; after it the old-WAL unlink is idempotent cleanup), and
+        # the compaction edge one level up fires the ``index.compact``
+        # chaos seam kill tests drive
+        self._write_current_locked()   # dfslint: ignore[DFS013]
         if self._wal_fd is not None:
             os.close(self._wal_fd)
         self._wal_fd = new_fd

@@ -165,6 +165,10 @@ class RingManager:
                           if prev is not None else "")
 
     def _persist(self) -> None:
+        # Deliberately NOT fsync'd (so dfslint DFS011 never binds this
+        # function): ring.json is a resume hint, not acked state — a
+        # snapshot lost to power failure is re-taught by epoch gossip,
+        # and the atomic rename alone already rules out a torn file.
         try:
             _atomic_write(self._state_path, json.dumps(
                 {"current": self.current.to_dict(),

@@ -48,7 +48,7 @@ class SimPlane:
         self._band_keys = band_keys
         self.bands = BandIndex(Path(root),
                                per_key=max(8, cfg.max_candidates))
-        self.crash = None              # chaos seam: injector.maybe_crash
+        self._crash = None             # chaos seam: injector.maybe_crash
         self._mu = threading.Lock()
         self._reads: dict[str, int] = {}   # delta digest -> reads since stored
         # counters (sim_stats / the /metrics "sim" table)
@@ -62,9 +62,21 @@ class SimPlane:
         self.missing_base = 0          # reconstructions refused: base gone
 
     # -- chaos ----------------------------------------------------------
+    @property
+    def crash(self):
+        """The chaos seam (``injector.maybe_crash`` when chaos is on).
+        Setting it also arms the band index, so ``sim.band_compact``
+        fires inside the real log-compaction path."""
+        return self._crash
+
+    @crash.setter
+    def crash(self, fn) -> None:
+        self._crash = fn
+        self.bands.crash = fn
+
     def maybe_crash(self, point: str) -> None:
-        if self.crash is not None:
-            self.crash(point)
+        if self._crash is not None:
+            self._crash(point)
 
     # -- write path -----------------------------------------------------
     def sketch_for_batch(self, store, items) -> dict:
@@ -183,6 +195,7 @@ class SimPlane:
                 "missingBase": self.missing_base,
                 "bandKeys": self.bands.keys_total(),
                 "bandEntries": len(self.bands),
+                "bandCompactions": self.bands.compactions,
                 "sketchDegraded": self.sketcher._unavailable,
             }
 

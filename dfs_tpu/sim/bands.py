@@ -15,7 +15,17 @@ miniature:
 - the in-memory map is bounded per key (newest candidates win) and
   rebuilt from the log at open; anything structurally wrong with the
   file degrades to an empty index, because the chunk files are the
-  ground truth and the band index is only an optimization.
+  ground truth and the band index is only an optimization;
+- the log COMPACTS itself (ROADMAP item 6): per-key bounding means
+  most appended records are dead — evicted from their deque by newer
+  candidates — so once the log carries ``compact_factor`` bytes per
+  live byte (and is past ``compact_min_bytes``), ``add`` rewrites just
+  the live records through a temp file with the full crash-safe
+  idiom: create-only ``"xb"`` open, payload fsync, the registered
+  ``sim.band_compact`` chaos crash point, atomic ``os.replace``,
+  directory fsync. kill -9 anywhere leaves either the old complete
+  log or the new complete log — never a mix (the leftover temp from a
+  mid-compaction crash is unlinked by the next attempt).
 """
 
 from __future__ import annotations
@@ -29,20 +39,32 @@ from pathlib import Path
 
 _REC = struct.Struct(">IQ32s")     # crc32(key||digest), band key, digest
 
+# compaction trigger: rewrite once the log holds this many bytes per
+# LIVE byte — and never below the floor, where rewriting is noise
+_COMPACT_FACTOR = 4
+_COMPACT_MIN_BYTES = 1 << 16
+
 
 class BandIndex:
     """Bounded band-key -> recent-digests map over an append-only log.
     Thread-safe: adds arrive from the CAS worker threads."""
 
-    def __init__(self, root: Path, per_key: int = 8) -> None:
+    def __init__(self, root: Path, per_key: int = 8,
+                 compact_factor: int = _COMPACT_FACTOR,
+                 compact_min_bytes: int = _COMPACT_MIN_BYTES) -> None:
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
         self.path = self.root / "bands.log"
         self.per_key = max(1, int(per_key))
+        self.compact_factor = max(2, int(compact_factor))
+        self.compact_min_bytes = max(_REC.size, int(compact_min_bytes))
+        self.crash = None   # chaos seam, wired through SimPlane.crash
+        self.compactions = 0
         self._mu = threading.Lock()
         self._map: dict[int, collections.deque[str]] = {}
         self.replayed = 0
         self.truncated = 0
+        self._log_bytes = 0
         self._replay()
         self._fh = open(self.path, "ab")
 
@@ -65,6 +87,11 @@ class BandIndex:
             self.truncated = len(blob) - good
             with open(self.path, "r+b") as fh:
                 fh.truncate(good)
+        self._log_bytes = good
+
+    def maybe_crash(self, point: str) -> None:
+        if self.crash is not None:
+            self.crash(point)
 
     def _note(self, key: int, digest: str) -> None:
         dq = self._map.get(key)
@@ -76,14 +103,59 @@ class BandIndex:
 
     def add(self, digest: str, keys: list[int]) -> None:
         """Record ``digest`` under its band keys (buffered append; no
-        fsync — see module docstring for why losing it is safe)."""
+        fsync — see module docstring for why losing it is safe).
+        Triggers a compaction when the dead:live ratio crosses the
+        configured factor."""
         raw = bytes.fromhex(digest)
         with self._mu:
             for key in keys:
                 body = _REC.pack(0, key, raw)[4:]
                 self._fh.write(struct.pack(">I", zlib.crc32(body)) + body)
                 self._note(key, digest)
+                self._log_bytes += _REC.size
             self._fh.flush()
+            live = sum(len(dq) for dq in self._map.values())
+            if self._log_bytes >= self.compact_min_bytes \
+                    and self._log_bytes >= \
+                    self.compact_factor * live * _REC.size:
+                self._compact_locked(live)
+
+    def compact(self) -> int:
+        """Rewrite the log down to the live records (public entry for
+        tests/tools; ``add`` triggers it automatically). Returns the
+        number of records written."""
+        with self._mu:
+            return self._compact_locked(
+                sum(len(dq) for dq in self._map.values()))
+
+    def _compact_locked(self, live: int) -> int:
+        """The crash-safe log rewrite, ``_mu`` held. Exactly the
+        DFS011 ordering discipline: temp written create-only ("xb" —
+        a leftover from a crashed run is unlinked first, never
+        appended onto), payload fsynced BEFORE the atomic rename makes
+        it visible, directory entry fsynced after. The registered
+        ``sim.band_compact`` crash point fires in the widest window —
+        new log durable at its temp name, old log still the visible
+        one — where replay must still serve the OLD complete log."""
+        tmp = self.path.with_suffix(".compact")
+        tmp.unlink(missing_ok=True)
+        with open(tmp, "xb") as fh:
+            for key, dq in self._map.items():
+                # deques hold newest-first; replay appendleft-rebuilds
+                # that order only from an oldest-first file
+                for digest in reversed(dq):
+                    body = _REC.pack(0, key, bytes.fromhex(digest))[4:]
+                    fh.write(struct.pack(">I", zlib.crc32(body)) + body)
+            fh.flush()
+            os.fsync(fh.fileno())
+        self.maybe_crash("sim.band_compact")
+        self._fh.close()
+        os.replace(tmp, self.path)
+        self._fsync_dir()
+        self._fh = open(self.path, "ab")
+        self._log_bytes = live * _REC.size
+        self.compactions += 1
+        return live
 
     def lookup(self, keys: list[int], exclude: str | None = None,
                limit: int = 8) -> list[str]:
@@ -109,14 +181,9 @@ class BandIndex:
         with self._mu:
             return len(self._map)
 
-    def close(self) -> None:
-        with self._mu:
-            try:
-                self._fh.close()
-            except OSError:
-                pass
-        # sync the log's directory entry once at shutdown so a clean
-        # stop persists the index across an immediate power cut
+    def _fsync_dir(self) -> None:
+        """Sync the log's directory entry (after a compaction rename,
+        and once at clean shutdown)."""
         try:
             fd = os.open(self.root, os.O_RDONLY)
             try:
@@ -125,3 +192,13 @@ class BandIndex:
                 os.close(fd)
         except OSError:
             pass
+
+    def close(self) -> None:
+        with self._mu:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+        # sync the log's directory entry once at shutdown so a clean
+        # stop persists the index across an immediate power cut
+        self._fsync_dir()

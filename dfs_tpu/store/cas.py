@@ -1036,6 +1036,12 @@ class ManifestStore:
         the timestamp as it gossips until it postdates (and destroys) a
         legitimate re-upload."""
         with self._lock(file_id):   # atomic vs save() — see __init__
+            # two-step sequence without a crash point: the tombstone
+            # lands BEFORE the manifest unlink precisely so a kill -9
+            # between them errs toward delete (the acked operation) —
+            # the stale manifest is masked by is_tombstoned and swept
+            # by anti-entropy; no window loses an ack
+            # dfslint: ignore[DFS013]
             _atomic_write(self._tomb_path(file_id),
                           json.dumps({"ts": time.time() if ts is None
                                       else float(ts)}).encode(),

@@ -150,7 +150,12 @@ class TemperatureLedger:
         """Atomic JSON snapshot (the CAS _atomic_write discipline —
         rename-committed, never a torn file). Called on the worker
         cadence and at shutdown; losing the tail since the last
-        snapshot only under-counts heat, which is the safe direction."""
+        snapshot only under-counts heat, which is the safe direction.
+
+        Deliberately NOT fsync'd (so dfslint DFS011 never binds this
+        function): heat history is advisory, the durable tier bit
+        lives in the digest index + manifests, and a snapshot lost to
+        power failure just re-arms the min_idle_s boot grace."""
         root.mkdir(parents=True, exist_ok=True)
         doc = {"version": _LEDGER_VERSION, "bootAt": self.boot_at,
                "entries": {d: [round(e[0], 3), round(e[1], 4)]

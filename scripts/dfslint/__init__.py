@@ -40,13 +40,21 @@ __all__ = ["ALL_RULES", "Finding", "Project", "ProjectModel",
 
 def analyze(roots, repo_root,
             baseline: set[str] | frozenset[str] = frozenset(),
-            stats: dict | None = None) -> list[Finding]:
+            stats: dict | None = None,
+            only_paths: set[str] | None = None) -> list[Finding]:
     """Walk ``roots``, run every rule (phase-1 model built once, shared
     by all of them), drop suppressed + baselined findings, and audit
     stale baseline entries. The one entry point the CLI and the tier-1
     test share. ``stats``, when given, is filled in place with the
     ``--stats`` timing breakdown: ``files``, ``walkS``, ``totalS``,
-    and per-phase ``phases`` (model + each rule + audit)."""
+    and per-phase ``phases`` (model + each rule + audit).
+
+    ``only_paths`` (the ``--changed`` mode): REPORT only findings whose
+    path is in the set, but still walk and model the full ``roots`` —
+    the interprocedural facts (call graph, affinity, persistence
+    effects) stay whole-tree sound, so a changed callee still fires on
+    its unchanged caller's path being absent rather than on a model
+    built from a partial tree."""
     t_start = time.perf_counter()
     project = Project(collect_sources(roots, repo_root))
     t_walk = time.perf_counter() - t_start
@@ -55,6 +63,8 @@ def analyze(roots, repo_root,
     live_keys = {f.key for f in findings}
     out = [f for f in findings if f.key not in baseline]
     out.extend(audit_baseline(project, set(baseline), live_keys))
+    if only_paths is not None:
+        out = [f for f in out if f.path in only_paths]
     out.sort(key=lambda f: (f.path, f.line, f.rule))
     if stats is not None:
         stats.update({

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
 
@@ -67,11 +68,35 @@ def to_sarif(findings) -> dict:
     }
 
 
+def changed_paths(repo_root: Path, base: str | None = None) -> set[str]:
+    """Repo-relative paths touched per git: the worktree/index diff
+    (plus, with ``base``, committed changes since that ref) and
+    untracked files. Empty set = nothing changed. Raises ValueError
+    when git itself fails (not a repo, bad ref) — the CLI maps that to
+    exit 2 like any other usage error."""
+    out: set[str] = set()
+    cmds = [["git", "diff", "--name-only", "HEAD"],
+            ["git", "ls-files", "--others", "--exclude-standard"]]
+    if base:
+        cmds.append(["git", "diff", "--name-only", f"{base}...HEAD"])
+    for cmd in cmds:
+        try:
+            res = subprocess.run(cmd, cwd=repo_root, text=True,
+                                 capture_output=True, check=True)
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            raise ValueError(
+                f"--changed: {' '.join(cmd)} failed: "
+                f"{detail.strip()}") from e
+        out.update(line for line in res.stdout.splitlines() if line)
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m scripts.dfslint",
-        description="two-phase AST concurrency & invariant analyzer "
-                    "for the async node runtime (rules DFS001-DFS010, "
+        description="multi-phase AST concurrency & invariant analyzer "
+                    "for the async node runtime (rules DFS001-DFS013, "
                     "docs/lint.md)")
     ap.add_argument("paths", nargs="*", default=list(DEFAULT_ROOTS),
                     help="files/dirs/globs relative to the repo root "
@@ -85,6 +110,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--stats", action="store_true",
                     help="print the per-phase timing breakdown (text) "
                          "/ embed it (json)")
+    ap.add_argument("--changed", nargs="?", const="", default=None,
+                    metavar="BASE",
+                    help="report only findings in git-changed files "
+                         "(worktree + index vs HEAD, plus commits "
+                         "since BASE when given) — the model is still "
+                         "built whole-tree, so interprocedural facts "
+                         "stay sound; for fast pre-commit runs")
     ap.add_argument("--baseline", default=None, metavar="PATH",
                     help=f"baseline file (default {DEFAULT_BASELINE})")
     ap.add_argument("--update-baseline", action="store_true",
@@ -101,9 +133,20 @@ def main(argv: list[str] | None = None) -> int:
     try:
         baseline = set() if args.update_baseline \
             else load_baseline(args.baseline)
+        only = None
+        if args.changed is not None:
+            if args.update_baseline:
+                print("dfslint: --changed cannot combine with "
+                      "--update-baseline (a filtered run must not "
+                      "rewrite the accepted set)", file=sys.stderr)
+                return 2
+            only = changed_paths(REPO_ROOT, args.changed or None)
+            if not only:
+                return 0   # nothing changed: trivially clean
         findings = analyze(args.paths or list(DEFAULT_ROOTS), REPO_ROOT,
                            baseline=baseline,
-                           stats=stats if args.stats else None)
+                           stats=stats if args.stats else None,
+                           only_paths=only)
     except FileNotFoundError as e:
         print(f"dfslint: no such path: {e}", file=sys.stderr)
         return 2

@@ -1,0 +1,649 @@
+"""Phase 3 of the interprocedural analyzer: the persistence-ordering
+model and the crash-consistency rules (DFS011/DFS012/DFS013).
+
+The system's durability promise — "no acked write is ever lost,
+kill -9 anywhere" — rests on hand-maintained ORDERING disciplines:
+temp-write → fsync → link (store/cas.py), payload-fsync → rename →
+dir-fsync (``_atomic_write(fsync=True)``), ``"xb"`` create-only
+segment opens (obs/journal.py), CRC-framed torn-tail-truncating
+replay (index/lsi.py, sim/bands.py), and re-fsync after
+metadata-only mutations (the r13 LWW-mtime bug: ``os.utime`` after
+the write barrier reverts on power loss unless followed by its own
+fsync). Until now those disciplines were only *sampled* dynamically
+at the registered chaos crash points; this pass encodes them as
+whole-tree lexical facts, the same way phase 1/2 (model.py,
+rules.py) encoded the r13 race and r15 buffer-lifetime shapes.
+
+Like everything in dfslint this is a best-effort lexical
+approximation biased toward silence: an effect the classifier cannot
+see contributes nothing, and every ordering sub-check requires the
+function to opt INTO fsync-awareness (it issues a barrier somewhere)
+before any ordering is demanded of it — a module whose crash safety
+is by ordering alone (index/lsi.py CURRENT swap) or deliberately
+best-effort (ring/manager.py ring.json, tier ledger snapshots) stays
+silent because it never fsyncs in the first place.
+
+Effect vocabulary (per ``ast.Call``, classified lexically):
+
+- WRITE    — ``f.write(...)`` / ``os.write``: bytes into a file that
+             are NOT yet durable;
+- BARRIER  — ``os.fsync`` / ``*fsync_path`` / a call to a function
+             whose own body issues a barrier (one resolved hop) / an
+             ``*atomic_write(..., fsync=<not-False>)``;
+- VISIBLE  — ``os.link`` / ``os.replace`` / ``os.rename``: the moment
+             a name atomically starts serving the new bytes;
+- ATOMIC   — an ``*atomic_write(...)`` call: internally ordered
+             write+rename, counted as one persistence step;
+- UNLINK   — ``os.unlink`` / ``os.remove`` / ``p.unlink()``;
+- UTIME    — ``os.utime``: metadata the preceding data fsync did NOT
+             cover;
+- OPEN     — ``open(path, mode-literal)`` with the mode retained
+             (``"xb"`` create-only vs ``"ab"``/``"wb"`` reopen);
+- SEAM     — ``*.maybe_crash("id")`` / ``self.hook("id")``: the
+             registered chaos crash seams.
+
+Effects inside ``except`` handlers and ``finally`` blocks are
+cleanup/fallback, not sequence steps, and are excluded from the
+ordering checks. The pass rides the phase-1 model's per-function call
+index — no AST subtree is re-walked per rule, which is what keeps the
+third phase inside the r17 ``--stats`` wall-clock budget.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterator
+
+from scripts.dfslint.core import Finding, Project, SourceFile, dotted
+from scripts.dfslint.model import FuncInfo, ProjectModel, build_model
+
+WRITE = "write"
+BARRIER = "barrier"
+VISIBLE = "visible"
+ATOMIC = "atomic"
+UNLINK = "unlink"
+UTIME = "utime"
+OPEN = "open"
+SEAM = "seam"
+
+_VISIBLE_CALLS = frozenset({"os.link", "os.replace", "os.rename"})
+_UNLINK_CALLS = frozenset({"os.unlink", "os.remove"})
+_WRITE_MODES = frozenset({"ab", "wb", "w", "a", "r+b", "w+b", "xb", "x"})
+_CREATE_MODES = frozenset({"xb", "x"})
+
+
+class Effect:
+    """One classified filesystem effect inside a function body."""
+
+    __slots__ = ("kind", "node", "line", "cleanup", "detail")
+
+    def __init__(self, kind: str, node: ast.AST, cleanup: bool,
+                 detail=None) -> None:
+        self.kind = kind
+        self.node = node
+        self.line = getattr(node, "lineno", 0)
+        self.cleanup = cleanup
+        self.detail = detail
+
+
+def _open_mode(call: ast.Call) -> str | None:
+    """The literal mode of an ``open(...)`` call, None when dynamic or
+    defaulted (default is read — not this pass's business)."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None
+
+
+def _fsync_kw_on(call: ast.Call) -> bool:
+    """True when an ``*atomic_write`` call passes ``fsync=`` anything
+    but a literal False — ``fsync=self._fsync`` counts: the function
+    participates in the durability mode and owes the ordering."""
+    for kw in call.keywords:
+        if kw.arg == "fsync":
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False)
+    return False
+
+
+def _string_constants(node: ast.AST) -> Iterator[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            yield sub.value
+
+
+def _seam_id(call: ast.Call) -> str | None:
+    """The crash-point id of a ``*.maybe_crash("id")`` /
+    ``self.hook("id")`` chaos-seam call, else None."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    if call.func.attr not in ("maybe_crash", "hook"):
+        return None
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+class PersistenceModel:
+    """Per-function filesystem-effect lists over the phase-1 model's
+    call index, plus the one-hop call summaries. Built once per
+    project (cached on the model), shared by all three rules."""
+
+    def __init__(self, model: ProjectModel) -> None:
+        self.model = model
+        self._cleanup: dict[int, set[int]] = {}   # id(src) -> node ids
+        # pass 1: direct effects only — also the one-hop summary source
+        self.direct: dict[str, list[Effect]] = {}
+        deferred: dict[str, list[tuple[ast.Call, bool]]] = {}
+        for fn in model.functions.values():
+            if isinstance(fn.node, ast.Lambda):
+                continue
+            effects: list[Effect] = []
+            later: list[tuple[ast.Call, bool]] = []
+            cleanup = self._cleanup_ids(fn.src)
+            for call in model._calls_of.get(fn.uid, ()):
+                in_cleanup = id(call) in cleanup
+                if not self._classify(effects, call, in_cleanup):
+                    later.append((call, in_cleanup))
+            self.direct[fn.uid] = effects
+            deferred[fn.uid] = later
+        # pass 2: one resolved hop — a call to a function whose own
+        # body issues a barrier/visible/seam effect is that effect at
+        # the call line (enough to see _fsync_path, _atomic_write
+        # wrappers, and seam-bearing helpers through one indirection)
+        self.effects: dict[str, list[Effect]] = {}
+        for fn in model.functions.values():
+            if isinstance(fn.node, ast.Lambda):
+                continue
+            effects = list(self.direct[fn.uid])
+            for call, in_cleanup in deferred[fn.uid]:
+                callee = model.resolve_call(fn.src, fn, call.func)
+                if callee is None:
+                    continue
+                summary = {e.kind for e in
+                           self.direct.get(callee.uid, ())
+                           if not e.cleanup}
+                if BARRIER in summary or any(
+                        e.kind == ATOMIC and e.detail
+                        for e in self.direct.get(callee.uid, ())):
+                    effects.append(Effect(BARRIER, call, in_cleanup))
+                if VISIBLE in summary or ATOMIC in summary:
+                    effects.append(Effect(ATOMIC, call, in_cleanup))
+                if SEAM in summary:
+                    effects.append(Effect(SEAM, call, in_cleanup))
+            effects.sort(key=lambda e: e.line)
+            self.effects[fn.uid] = effects
+
+    def _cleanup_ids(self, src: SourceFile) -> set[int]:
+        got = self._cleanup.get(id(src))
+        if got is not None:
+            return got
+        out: set[int] = set()
+        for n in src.nodes(ast.Try):
+            for h in n.handlers:
+                for sub in ast.walk(h):
+                    out.add(id(sub))
+            for st in n.finalbody:
+                for sub in ast.walk(st):
+                    out.add(id(sub))
+        self._cleanup[id(src)] = out
+        return out
+
+    @staticmethod
+    def _classify(effects: list[Effect], call: ast.Call,
+                  cleanup: bool) -> bool:
+        """Append the call's direct effect (True) or report it
+        unmatched (False — candidate for the one-hop pass)."""
+        name = dotted(call.func)
+        last = name.rsplit(".", 1)[-1] if name else None
+        add = effects.append
+        if name in _VISIBLE_CALLS:
+            add(Effect(VISIBLE, call, cleanup, detail=name))
+            return True
+        if name in _UNLINK_CALLS or (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr == "unlink"):
+            add(Effect(UNLINK, call, cleanup))
+            return True
+        if name == "os.fsync" or (last and last.endswith("fsync_path")):
+            add(Effect(BARRIER, call, cleanup))
+            return True
+        if name == "os.utime":
+            add(Effect(UTIME, call, cleanup))
+            return True
+        if name == "os.write":
+            add(Effect(WRITE, call, cleanup))
+            return True
+        if last and last.endswith("atomic_write"):
+            add(Effect(ATOMIC, call, cleanup, detail=_fsync_kw_on(call)))
+            return True
+        seam = _seam_id(call)
+        if seam is not None:
+            add(Effect(SEAM, call, cleanup, detail=seam))
+            return True
+        if name == "open" or last == "fdopen":
+            mode = _open_mode(call)
+            if mode in _WRITE_MODES:
+                add(Effect(OPEN, call, cleanup, detail=mode))
+            return True   # read-mode opens carry no ordering effect
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "write":
+            add(Effect(WRITE, call, cleanup))
+            return True
+        if name == "os.open":
+            if any(d and "O_EXCL" in d
+                   for d in (dotted(a) for a in ast.walk(call))):
+                add(Effect(OPEN, call, cleanup, detail="xb"))
+            return True
+        return False
+
+    def of(self, fn: FuncInfo, *kinds: str,
+           live_only: bool = False) -> list[Effect]:
+        return [e for e in self.effects.get(fn.uid, ())
+                if e.kind in kinds and not (live_only and e.cleanup)]
+
+    def fsync_aware(self, fn: FuncInfo) -> bool:
+        """The function itself issues (or conditionally issues) a
+        durability barrier — only then does it owe barrier ordering."""
+        for e in self.effects.get(fn.uid, ()):
+            if e.kind == BARRIER:
+                return True
+            if e.kind == ATOMIC and e.detail:
+                return True
+        return False
+
+
+def persistence_model(project: Project) -> PersistenceModel:
+    """Build (or return the cached) phase-3 effect model."""
+    model = build_model(project)
+    cached = getattr(model, "_persistence", None)
+    if cached is None:
+        cached = model._persistence = PersistenceModel(model)
+    return cached
+
+
+def _each_fn(model: ProjectModel) -> Iterator[FuncInfo]:
+    for fn in model.functions.values():
+        if not isinstance(fn.node, ast.Lambda):
+            yield fn
+
+
+# ------------------------------------------------------------------ #
+# DFS011 — durability ordering
+# ------------------------------------------------------------------ #
+
+# per-boot append-only segment paths: resolved by the path-factory
+# naming convention (``self._segment_path()``) or a literal segment
+# name in the open target (journal ``events-<boot>-<seq>.jsonl``)
+_SEGMENT_FACTORY = re.compile(r"segment_path$")
+_SEGMENT_LITERAL = re.compile(r"events-.*\.jsonl")
+
+
+def _is_segment_target(call: ast.Call) -> bool:
+    target = call.args[0] if call.args else None
+    if target is None:
+        return False
+    if isinstance(target, ast.Call):
+        name = dotted(target.func)
+        if name and _SEGMENT_FACTORY.search(name):
+            return True
+    return any(_SEGMENT_LITERAL.search(s)
+               for s in _string_constants(target))
+
+
+def check_durability_ordering(project: Project) -> Iterator[Finding]:
+    """DFS011: in fsync-aware functions (the function issues — or
+    conditionally issues — a durability barrier, i.e. it participates
+    in ``DurabilityConfig.mode == "fsync"``), enforce the three
+    crash-consistency orderings:
+
+    - **visible-before-durable**: a visibility point (``os.link`` /
+      ``os.replace`` / ``os.rename``) must be dominated by the fsync
+      barrier of the bytes it publishes — a lexical ``.write()`` with
+      no barrier between it and the link means a crash after the ack
+      can serve a name pointing at unsynced pages;
+    - **utime-after-barrier** (the r13 LWW-mtime bug): ``os.utime``
+      after the data barrier is metadata the barrier did not cover —
+      it must be followed by its own re-fsync or the mtime (the LWW
+      ordering side against tombstones) silently reverts on power
+      loss;
+    - **segment-reopen**: a per-boot append-only segment path must be
+      opened ``"xb"`` (create-only) — an ``"ab"``/``"wb"`` reopen
+      glues a new boot onto a possibly-torn tail (or truncates acked
+      records) when the boot-id clock collides (journal.py's
+      same-second reopen shape). Applies regardless of
+      fsync-awareness: the journal is deliberately fsync-free and
+      still needs ``"xb"``.
+
+    Functions that never fsync are NOT held to the first two: crash
+    safety by pure ordering (index/lsi.py CURRENT swap) and
+    deliberate best-effort state (ring.json, tier ledger snapshots)
+    are design points, not findings.
+    """
+    pm = persistence_model(project)
+    for fn in _each_fn(pm.model):
+        for e in pm.of(fn, OPEN):
+            if e.detail not in _CREATE_MODES \
+                    and _is_segment_target(e.node):
+                yield Finding(
+                    "DFS011", "error", fn.src.rel, e.line,
+                    e.node.col_offset,
+                    f"append-only segment opened with mode "
+                    f"{e.detail!r} — the crash-safe idiom is a "
+                    "create-only \"xb\" open (an append reopen glues "
+                    "this boot onto a possibly-torn tail when the "
+                    "boot id collides; see obs/journal.py)",
+                    f"{fn.qual}:segment-open")
+        if not pm.fsync_aware(fn):
+            continue
+        barriers = [e.line for e in pm.of(fn, BARRIER)]
+        writes = [e.line for e in pm.of(fn, WRITE, live_only=True)]
+        for e in pm.of(fn, VISIBLE, live_only=True):
+            prior = [w for w in writes if w < e.line]
+            if not prior:
+                continue
+            last_write = max(prior)
+            if not any(last_write < b <= e.line for b in barriers):
+                yield Finding(
+                    "DFS011", "error", fn.src.rel, e.line,
+                    e.node.col_offset,
+                    f"visibility point {e.detail}() publishes bytes "
+                    f"written at line {last_write} with no fsync "
+                    "barrier between write and link/rename — a crash "
+                    "after the ack can leave the visible name serving "
+                    "unsynced pages (fsync the payload fd first; see "
+                    "store/cas.py _put_raw)",
+                    f"{fn.qual}:visible-before-durable")
+        for e in pm.of(fn, UTIME):
+            if not any(b > e.line for b in barriers):
+                yield Finding(
+                    "DFS011", "error", fn.src.rel, e.line,
+                    e.node.col_offset,
+                    "os.utime after the data barrier is metadata the "
+                    "barrier did not cover — without a re-fsync of the "
+                    "path the mtime reverts on power loss (the r13 "
+                    "LWW-mtime bug: an adopted manifest's reverted "
+                    "mtime beats a legitimate delete); follow with "
+                    "_fsync_path(path)",
+                    f"{fn.qual}:utime-after-barrier")
+
+
+# ------------------------------------------------------------------ #
+# DFS012 — torn-read discipline
+# ------------------------------------------------------------------ #
+
+# append-only on-disk formats and the modules whose decoders are
+# blessed to read them raw (everyone else must route through those
+# decoders — read_events, _replay/_replay_wal, parse_header — which
+# CRC-validate and truncate/skip torn tails instead of exploding on
+# them or, worse, trusting half a record)
+_FORMATS = (
+    (re.compile(r"events-.*\.jsonl|events-\*"), "obs journal segments",
+     ("dfs_tpu/obs/journal.py",), "obs.journal.read_events"),
+    (re.compile(r"\bwal-"), "LSI write-ahead log",
+     ("dfs_tpu/index/lsi.py",), "index.lsi DigestIndex._replay_wal"),
+    (re.compile(r"bands\.log"), "sim band log",
+     ("dfs_tpu/sim/bands.py",), "sim.bands BandIndex._replay"),
+    (re.compile(r"\bdeltas/"), "DSD1 delta records",
+     ("dfs_tpu/store/cas.py", "dfs_tpu/sim/delta.py"),
+     "sim.delta.parse_header/apply_delta"),
+)
+
+_RAW_READERS = frozenset({"read_bytes", "read_text"})
+
+
+def _read_target(call: ast.Call) -> ast.AST | None:
+    """The path expression of a raw-read call, else None. Raw reads:
+    ``open(p)`` / ``open(p, "rb"/"r")``, ``p.read_bytes()``,
+    ``p.read_text()``."""
+    name = dotted(call.func)
+    if name == "open" or (name and name.endswith(".open")):
+        mode = _open_mode(call)
+        if mode is None or mode in ("rb", "r"):
+            if name == "open":
+                return call.args[0] if call.args else None
+            return call.func.value
+        return None
+    if isinstance(call.func, ast.Attribute) \
+            and call.func.attr in _RAW_READERS:
+        return call.func.value
+    return None
+
+
+def check_torn_read_discipline(project: Project) -> Iterator[Finding]:
+    """DFS012: the append-only on-disk formats (obs journal segments,
+    LSI WAL, sim ``bands.log``, DSD1 delta records) end in a torn tail
+    after any kill -9 — that is the design, and each format ships ONE
+    decoder that CRC-validates / truncates it. A raw ``open()`` /
+    ``read_bytes()`` over such a path anywhere else either crashes on
+    the tail, or silently trusts half a record; both read as working
+    code until the first mid-write power cut. Route through the
+    blessed decoder."""
+    pm = persistence_model(project)
+    for fn in _each_fn(pm.model):
+        src = fn.src
+        for call in pm.model._calls_of.get(fn.uid, ()):
+            target = _read_target(call)
+            if target is None:
+                continue
+            literals = list(_string_constants(target))
+            if not literals:
+                continue
+            for pat, what, blessed, decoder in _FORMATS:
+                if any(src.rel.endswith(b) for b in blessed):
+                    continue
+                if any(pat.search(s) for s in literals):
+                    yield Finding(
+                        "DFS012", "error", src.rel, call.lineno,
+                        call.col_offset,
+                        f"raw read of {what} — the format is append-"
+                        "only and ends in a torn tail after kill -9; "
+                        f"route through the blessed decoder "
+                        f"({decoder}), which CRC-validates and "
+                        "truncates instead of trusting half a record",
+                        f"{fn.qual}:torn-read:{pat.pattern}")
+                    break
+
+
+# ------------------------------------------------------------------ #
+# DFS013 — crash-point coverage
+# ------------------------------------------------------------------ #
+
+def _find_registry(project: Project
+                   ) -> tuple[SourceFile, dict[str, int]] | None:
+    """The ``CRASH_POINTS = frozenset({...})`` registry: file plus
+    id -> declaration line."""
+    for src in project.files:
+        if src.tree is None or "CRASH_POINTS" not in src.text:
+            continue
+        for node in src.nodes(ast.Assign):
+            if not (len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "CRASH_POINTS"):
+                continue
+            value = node.value
+            if isinstance(value, ast.Call) and value.args:
+                value = value.args[0]
+            if isinstance(value, ast.Set):
+                ids = {e.value: e.lineno for e in value.elts
+                       if isinstance(e, ast.Constant)
+                       and isinstance(e.value, str)}
+                if ids:
+                    return src, ids
+    return None
+
+
+def _repo_root_of(src: SourceFile) -> Path:
+    root = src.path
+    for _ in Path(src.rel).parts:
+        root = root.parent
+    return root
+
+
+def _loop_prefixes(tree: ast.Module) -> list[tuple[bool, tuple[str, ...]]]:
+    """Prefix filters of every comprehension/genexp iterating the
+    CRASH_POINTS registry: ``(positive, prefixes)`` per filter.
+    ``sorted(p for p in CRASH_POINTS if p.startswith("demote."))`` is
+    the positive kill-loop idiom (tests/test_tiering.py); ``if not
+    p.startswith(("demote.", "sim."))`` the complementary one
+    (tests/test_chaos.py). An UNfiltered loop over the registry is the
+    knob-validation idiom, not a kill loop, and earns no credit."""
+    out: list[tuple[bool, tuple[str, ...]]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.GeneratorExp, ast.ListComp,
+                                 ast.SetComp)):
+            continue
+        for gen in node.generators:
+            names = {n.id for n in ast.walk(gen.iter)
+                     if isinstance(n, ast.Name)}
+            if "CRASH_POINTS" not in names:
+                continue
+            for cond in gen.ifs:
+                positive, call = True, cond
+                if isinstance(cond, ast.UnaryOp) \
+                        and isinstance(cond.op, ast.Not):
+                    positive, call = False, cond.operand
+                if not (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Attribute)
+                        and call.func.attr == "startswith"
+                        and call.args):
+                    continue
+                arg = call.args[0]
+                prefixes: tuple[str, ...] = ()
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str):
+                    prefixes = (arg.value,)
+                elif isinstance(arg, ast.Tuple):
+                    prefixes = tuple(e.value for e in arg.elts
+                                     if isinstance(e, ast.Constant)
+                                     and isinstance(e.value, str))
+                if prefixes:
+                    out.append((positive, prefixes))
+    return out
+
+
+def _exercised_ids(root: Path, ids: set[str]) -> set[str]:
+    """Crash-point ids exercised by at least one test/bench file:
+    either the literal id appears (arming a specific point — the
+    bench_sim.py / test-kill idiom), or a prefix-FILTERED loop over
+    the registry covers it. Text-scans first, parses only on a hit —
+    the whole tests/ tree must not cost a parse per lint run."""
+    exercised: set[str] = set()
+    candidates = sorted(root.glob("bench*.py")) \
+        + sorted((root / "tests").glob("**/*.py"))
+    for path in candidates:
+        try:
+            text = path.read_text(encoding="utf-8", errors="replace")
+        except OSError:
+            continue
+        hit_ids = {i for i in ids if i in text}
+        loops = "CRASH_POINTS" in text
+        if not hit_ids and not loops:
+            continue
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            continue
+        if hit_ids:
+            # the id must live in a string CONSTANT (an arm, a knob
+            # value, or a kill-subprocess script) — a mention in a
+            # comment is not evidence of exercise
+            for n in ast.walk(tree):
+                if isinstance(n, ast.Constant) \
+                        and isinstance(n.value, str):
+                    exercised |= {i for i in hit_ids if i in n.value}
+        if loops:
+            for positive, prefixes in _loop_prefixes(tree):
+                for i in ids:
+                    matches = i.startswith(prefixes)
+                    if matches if positive else not matches:
+                        exercised.add(i)
+    return exercised
+
+
+def check_crash_point_coverage(project: Project) -> Iterator[Finding]:
+    """DFS013: the ``dfs_tpu.chaos.CRASH_POINTS`` registry is the
+    contract ("a new crash site must be added HERE to be exercised")
+    — this pass closes it from both ends. Every registered id must be
+    (a) FIRED at ≥1 source site (``*.maybe_crash("<id>")``) — a
+    registered-but-never-fired point is dead coverage that reads as
+    tested — and (b) EXERCISED by ≥1 test/bench kill loop (a literal
+    arm or a prefix-filtered loop over the registry). Conversely a
+    fired id absent from the registry would raise at injector-arm
+    time. And every function the effect model proves performs a
+    MULTI-STEP ordered persistence sequence (≥2 visibility-changing
+    steps outside cleanup paths) must fire a crash point / chaos seam
+    or carry a reasoned inline ignore — multi-step sequences are
+    exactly where kill -9 windows live."""
+    pm = persistence_model(project)
+    found = _find_registry(project)
+    reg_ids: dict[str, int] = {}
+    reg_src: SourceFile | None = None
+    if found is not None:
+        reg_src, reg_ids = found
+
+    fired: set[str] = set()
+    for fn in _each_fn(pm.model):
+        seams = pm.of(fn, SEAM)
+        for e in seams:
+            pid = e.detail
+            if not isinstance(pid, str):
+                continue
+            fired.add(pid)
+            if reg_src is not None and "." in pid \
+                    and pid not in reg_ids \
+                    and isinstance(e.node, ast.Call) \
+                    and isinstance(e.node.func, ast.Attribute) \
+                    and e.node.func.attr == "maybe_crash":
+                yield Finding(
+                    "DFS013", "error", fn.src.rel, e.line,
+                    e.node.col_offset,
+                    f"maybe_crash({pid!r}) fires a crash point that "
+                    "is not in dfs_tpu.chaos.CRASH_POINTS — arming it "
+                    "would raise ValueError at the injector; register "
+                    "it (the registry IS the contract)",
+                    f"chaos:{pid}:unregistered")
+
+        steps = pm.of(fn, VISIBLE, ATOMIC, UNLINK, live_only=True)
+        step_lines = {e.line for e in steps}
+        if len(step_lines) >= 2 and not seams:
+            first = min(steps, key=lambda e: e.line)
+            yield Finding(
+                "DFS013", "warning", fn.src.rel, first.line,
+                first.node.col_offset,
+                f"{fn.qual} performs a multi-step ordered persistence "
+                f"sequence ({len(step_lines)} visibility-changing "
+                "steps) with no registered crash point — every "
+                "interruption window between steps is untested by the "
+                "kill -9 matrix; fire injector.maybe_crash(<point>) "
+                "between steps or carry a reasoned "
+                "`# dfslint: ignore[DFS013]`",
+                f"chaos:{fn.qual}:multi-step")
+
+    if reg_src is None:
+        return
+    exercised = _exercised_ids(_repo_root_of(reg_src), set(reg_ids))
+    for pid, line in sorted(reg_ids.items()):
+        if pid not in fired:
+            yield Finding(
+                "DFS013", "error", reg_src.rel, line, 0,
+                f"crash point {pid!r} is registered but never fired "
+                "from any source site (*.maybe_crash) — dead coverage "
+                "that reads as tested; fire it or retire it",
+                f"chaos:{pid}:unfired")
+        if pid not in exercised:
+            yield Finding(
+                "DFS013", "error", reg_src.rel, line, 0,
+                f"crash point {pid!r} is not exercised by any "
+                "test/bench kill loop (no literal arm, no prefix-"
+                "filtered loop over CRASH_POINTS covers it) — the "
+                "registry promises every point is exercised",
+                f"chaos:{pid}:unexercised")

@@ -1,10 +1,13 @@
 """The dfslint passes. Each is a pure function over the parsed
 ``Project``; ``run_rules`` applies them all and filters inline
-suppressions. Since r17 the analyzer is **two-phase**: phase 1
+suppressions. Since r17 the analyzer is multi-phase: phase 1
 (scripts/dfslint/model.py) builds the whole-repo facts — call graph,
 execution-context classification, attribute/lock symbol table — once;
 phase 2 (this module) runs every rule against the shared parse and the
-shared model. The single-sentence-explainable discipline stands: a rule
+shared model; phase 3 (scripts/dfslint/durability.py) layers the
+persistence-ordering effect model on top for the crash-consistency
+rules DFS011-DFS013 (registered here like every other rule, so the
+CLI/SARIF/baseline plumbing applies unchanged). The single-sentence-explainable discipline stands: a rule
 fires only on facts the model actually established, and what the model
 cannot establish (dynamic dispatch, callables smuggled through
 containers) is documented per rule in docs/lint.md rather than
@@ -19,6 +22,9 @@ from typing import Iterator
 
 from scripts.dfslint.core import (Finding, Project, SourceFile, dotted,
                                   scope_nodes)
+from scripts.dfslint.durability import (check_crash_point_coverage,
+                                        check_durability_ordering,
+                                        check_torn_read_discipline)
 from scripts.dfslint.model import (LOOP, WORKER, build_model,
                                    is_view_expr, view_vars)
 
@@ -1359,6 +1365,16 @@ ALL_RULES = (
     ("DFS008", "thread-affinity race", check_affinity_race),
     ("DFS009", "buffer lifetime / view escape", check_buffer_lifetime),
     ("DFS010", "wire-protocol contract", check_wire_contract),
+    # phase 3 (scripts/dfslint/durability.py): the persistence-
+    # ordering model — crash-consistency disciplines as lexical facts
+    ("DFS011", "durability ordering (fsync-before-visible, re-fsync "
+     "after utime, create-only segment opens)",
+     check_durability_ordering),
+    ("DFS012", "torn-read discipline (append-only formats read via "
+     "blessed decoders)", check_torn_read_discipline),
+    ("DFS013", "crash-point coverage (registry fired + exercised, "
+     "multi-step persistence sequences seamed)",
+     check_crash_point_coverage),
 )
 
 

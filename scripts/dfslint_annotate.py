@@ -32,6 +32,32 @@ sys.path.insert(0, str(REPO_ROOT))
 from scripts.dfslint import analyze, load_baseline  # noqa: E402
 from scripts.dfslint.__main__ import DEFAULT_ROOTS  # noqa: E402
 
+# docs/lint.md catalogue anchor per rule — appended to every
+# annotation so the inline PR comment links straight to the rule's
+# rationale and fix idiom (kept in lockstep with ALL_RULES; the
+# test suite asserts every registered rule id has an entry)
+DOC_ANCHORS = {
+    "DFS000": "suppressions-and-the-baseline",
+    "DFS001": "dfs001--blocking-call-in-loop-affine-code",
+    "DFS002": "dfs002--dropped-task",
+    "DFS003": "dfs003--lock-discipline-across-the-syncasync-boundary",
+    "DFS004": "dfs004--digest-boundary",
+    "DFS005": "dfs005--config-drift-cli-flags--config-fields--metrics-keys",
+    "DFS006": "dfs006--data-plane-copy-discipline-r10",
+    "DFS007": "dfs007--no-silent-swallow-of-failure-class-exceptions-r11",
+    "DFS008": "dfs008--thread-affinity-race-r17-interprocedural",
+    "DFS009": "dfs009--buffer-lifetime--view-escape-r17-interprocedural",
+    "DFS010": "dfs010--wire-protocol-contract-r17-cross-file",
+    "DFS011": "dfs011--durability-ordering-r22-persistence-model",
+    "DFS012": "dfs012--torn-read-discipline-r22",
+    "DFS013": "dfs013--crash-point-coverage-r22",
+}
+
+
+def _doc_link(rule: str) -> str:
+    anchor = DOC_ANCHORS.get(rule)
+    return f" (docs/lint.md#{anchor})" if anchor else ""
+
 
 def _gh_escape(s: str) -> str:
     """Workflow-command data escaping (the Actions runner's rules:
@@ -71,10 +97,11 @@ def main(argv: list[str] | None = None) -> int:
             level = "error" if f.severity == "error" else "warning"
             print(f"::{level} file={_gh_prop(f.path)},line={line},"
                   f"col={max(1, f.col + 1)},title={_gh_prop(f.rule)}::"
-                  f"{_gh_escape(f.message)}")
+                  f"{_gh_escape(f.message + _doc_link(f.rule))}")
         else:
             print(f"{f.path}:{line}:{max(1, f.col + 1)}: "
-                  f"{f.rule} {f.severity}: {f.message}")
+                  f"{f.rule} {f.severity}: {f.message}"
+                  f"{_doc_link(f.rule)}")
     return 1 if findings else 0
 
 

@@ -20,6 +20,8 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO / "scripts"))
 sys.path.insert(0, str(REPO))
 
+import subprocess  # noqa: E402
+
 import check_artifacts  # noqa: E402
 
 from scripts import dfslint  # noqa: E402
@@ -34,7 +36,7 @@ def test_no_dangling_artifact_citations():
 
 
 def test_dfslint_gates_green():
-    """The analyzer half of the tier-1 lint slot: every DFS001-DFS005
+    """The analyzer half of the tier-1 lint slot: every DFS001-DFS013
     finding on the real tree is either fixed, inline-suppressed with a
     justification, or deliberately baselined."""
     findings = dfslint.analyze(list(DEFAULT_ROOTS), REPO,
@@ -42,6 +44,19 @@ def test_dfslint_gates_green():
     assert findings == [], (
         "dfslint violations (see docs/lint.md):\n  "
         + "\n  ".join(f.render() for f in findings))
+
+
+def test_dfslint_cli_gates_green_with_phase3_active():
+    """The exact CI invocation, end to end: ``python -m scripts.dfslint``
+    must exit 0 on the tree — with the r22 crash-consistency rules
+    (DFS011-013) REGISTERED, not merely importable, so a regression
+    that drops phase 3 from ALL_RULES cannot fake a green gate."""
+    assert {rid for rid, _desc, _fn in dfslint.ALL_RULES} >= {
+        "DFS011", "DFS012", "DFS013"}
+    r = subprocess.run([sys.executable, "-m", "scripts.dfslint"],
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
 
 
 def test_lint_catches_a_phantom(tmp_path):

@@ -26,10 +26,12 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import signal
 import socket
 import struct
 import subprocess
 import sys
+import textwrap
 from pathlib import Path
 
 import numpy as np
@@ -37,7 +39,7 @@ import pytest
 
 from dfs_tpu.config import (CDCParams, CensusConfig, ClusterConfig,
                             NodeConfig, PeerAddr, SimConfig)
-from dfs_tpu.sim.bands import BandIndex
+from dfs_tpu.sim.bands import _REC, BandIndex
 from dfs_tpu.sim.delta import (HEADER_BYTES, apply_delta, is_delta,
                                make_delta, parse_header)
 from dfs_tpu.sim.sketch import (EMPTY_LANE, SimSketcher, band_keys,
@@ -235,6 +237,72 @@ def test_band_index_bounds_candidates(tmp_path):
     assert idx.lookup([7]) == ["04" * 32, "03" * 32]    # newest 2 win
     assert idx.lookup([7], exclude="04" * 32) == ["03" * 32]
     idx.close()
+
+
+def test_band_log_compaction(tmp_path):
+    """Per-key bounding makes most log records dead; once the log
+    carries compact_factor bytes per live byte, add() rewrites it down
+    to the live set — and a replay of the compacted log reproduces the
+    exact newest-first candidate order."""
+    idx = BandIndex(tmp_path, per_key=2, compact_factor=2,
+                    compact_min_bytes=8 * _REC.size)
+    for i in range(40):
+        idx.add(f"{i:02d}" * 32, [7])
+    assert idx.compactions >= 1
+    assert idx.lookup([7]) == ["39" * 32, "38" * 32]
+    idx.close()
+    # compacted log replays to the same index (newest-first preserved)
+    idx2 = BandIndex(tmp_path, per_key=2)
+    assert idx2.lookup([7]) == ["39" * 32, "38" * 32]
+    # log is near the live size, not 40 appends deep
+    assert idx2.replayed <= 6
+    idx2.close()
+
+
+def test_band_log_compaction_kill9_crash_point(tmp_path):
+    """kill -9 at the registered ``sim.band_compact`` crash point —
+    compacted log durable at its temp name, bands.log NOT yet replaced
+    — must leave the OLD complete log serving replay, and the next
+    compaction must recover (unlink the leftover temp, not append to
+    it)."""
+    script = textwrap.dedent("""\
+        import os, signal, sys
+        from pathlib import Path
+        from dfs_tpu.sim.bands import BandIndex, _REC
+
+        root = Path(sys.argv[1])
+
+        def die(point):
+            if point == "sim.band_compact":
+                os.kill(os.getpid(), signal.SIGKILL)
+
+        idx = BandIndex(root, per_key=2, compact_factor=2,
+                        compact_min_bytes=_REC.size * 8)
+        idx.crash = die          # what SimPlane.crash wiring does
+        for i in range(40):
+            idx.add(f"{i:02d}" * 32, [7])
+        raise SystemExit("compaction never fired the crash point")
+        """)
+    res = subprocess.run(
+        [sys.executable, "-c", script, str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert res.returncode == -signal.SIGKILL, (res.returncode,
+                                               res.stdout, res.stderr)
+    # the crash window left both names: old log visible, temp durable
+    assert (tmp_path / "bands.log").exists()
+    assert (tmp_path / "bands.compact").exists()
+    # the old log is complete — replay takes every record, no torn tail
+    idx = BandIndex(tmp_path, per_key=2)
+    assert idx.truncated == 0
+    assert idx.lookup([7]), "acked adds survived the crash"
+    # recovery: the next compaction unlinks the leftover temp and swaps
+    idx.compact()
+    assert not (tmp_path / "bands.compact").exists()
+    assert idx.compactions == 1
+    idx.close()
+    idx2 = BandIndex(tmp_path, per_key=2)
+    assert idx2.lookup([7]) == idx.lookup([7])
+    idx2.close()
 
 
 # ------------------------------------------------------------------ #
